@@ -1,0 +1,68 @@
+"""madsim_tpu — a TPU-native deterministic-simulation-testing framework.
+
+A brand-new framework with the capabilities of the reference
+(skyzh/madsim, mounted at /root/reference): a deterministic async runtime
+for distributed systems that mocks scheduling, time, randomness, network
+and filesystem behind one seeded RNG, amplifies chaos (random
+interleavings, latency, loss, partitions, node kill/restart), and
+reproduces any failure exactly from its seed — plus simulated gRPC-, etcd-
+and Kafka-style services and a real backend for production.
+
+Unlike the reference (one OS thread per seeded run), the TPU-first core in
+:mod:`madsim_tpu.engine` advances thousands of seeded simulation instances
+in lockstep as one XLA-compiled step function — ``vmap`` over a seed axis,
+``shard_map`` over TPU meshes — with counter-based RNG draws replacing the
+serial RNG stream and a C++ oracle guaranteeing bit-identical traces.
+
+Layout (mirrors SURVEY.md §7):
+  * ``runtime/`` — single-seed deterministic async runtime (madsim core
+    parity: executor, virtual time, seeded RNG, chaos, test harness).
+  * ``net/`` — simulated network: NetSim, Endpoint, RPC, TCP/UDP.
+  * ``fs.py`` — simulated per-node filesystem.
+  * ``sync.py`` — deterministic async sync primitives.
+  * ``services/`` — gRPC-like / etcd-like / kafka-like simulators.
+  * ``engine/`` — batched JAX discrete-event core (the TPU path).
+  * ``models/`` — batched workloads (ping-pong, broadcast, raft election).
+  * ``parallel/`` — seed-axis sharding over device meshes.
+  * ``std/`` — real-world backends (production path).
+"""
+
+from .runtime import (  # noqa: F401
+    Builder,
+    Config,
+    DeadlockError,
+    DeterminismError,
+    Elapsed,
+    Handle,
+    Instant,
+    Interval,
+    JoinError,
+    JoinHandle,
+    NetConfig,
+    NodeBuilder,
+    NodeHandle,
+    Runtime,
+    SimFuture,
+    Simulator,
+    SystemTime,
+    TimeLimitError,
+    available_parallelism,
+    interval,
+    join_all,
+    main,
+    node,
+    now,
+    now_ns,
+    random,
+    select,
+    simulator,
+    sleep,
+    sleep_until,
+    spawn,
+    spawn_local,
+    test,
+    thread_rng,
+    timeout,
+)
+
+__version__ = "0.1.0"

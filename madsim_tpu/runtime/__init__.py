@@ -1,0 +1,78 @@
+"""Single-seed deterministic simulation runtime (the madsim-core parity
+layer; reference: /root/reference/madsim/src/sim/)."""
+
+from .builder import Builder, main, test
+from .config import Config, NetConfig, TcpConfig
+from .context import current_handle, in_simulation, try_current_handle
+from .future import Cancelled, SimFuture, join_all, select
+from .intercept import available_parallelism
+from .plugin import Simulator, node, simulator
+from .rand import DeterminismError, GlobalRng, random, thread_rng
+from .runtime import DEFAULT_SIMULATORS, Handle, NodeBuilder, NodeHandle, Runtime
+from .task import (
+    DeadlockError,
+    JoinError,
+    JoinHandle,
+    TimeLimitError,
+    spawn,
+    spawn_local,
+)
+from .time_ import (
+    Elapsed,
+    Instant,
+    Interval,
+    MissedTickBehavior,
+    SystemTime,
+    interval,
+    now,
+    now_ns,
+    sleep,
+    sleep_until,
+    timeout,
+)
+
+__all__ = [
+    "Builder",
+    "Cancelled",
+    "Config",
+    "DEFAULT_SIMULATORS",
+    "DeadlockError",
+    "DeterminismError",
+    "Elapsed",
+    "GlobalRng",
+    "Handle",
+    "Instant",
+    "Interval",
+    "JoinError",
+    "JoinHandle",
+    "MissedTickBehavior",
+    "NetConfig",
+    "NodeBuilder",
+    "NodeHandle",
+    "Runtime",
+    "SimFuture",
+    "Simulator",
+    "SystemTime",
+    "TcpConfig",
+    "TimeLimitError",
+    "available_parallelism",
+    "current_handle",
+    "in_simulation",
+    "interval",
+    "join_all",
+    "main",
+    "node",
+    "now",
+    "now_ns",
+    "random",
+    "select",
+    "simulator",
+    "sleep",
+    "sleep_until",
+    "spawn",
+    "spawn_local",
+    "test",
+    "thread_rng",
+    "timeout",
+    "try_current_handle",
+]

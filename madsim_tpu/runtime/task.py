@@ -1,0 +1,379 @@
+"""Deterministic single-threaded task executor with chaos semantics.
+
+Parity with reference madsim/src/sim/task.rs:
+  * discrete-event hot loop: drain the ready queue in *random* order, poll
+    each task, advance virtual time by a random 50-100 ns per poll, then
+    jump the clock to the next timer event (task.rs:142-216, the loop in
+    SURVEY §3.2).
+  * nodes (simulated machines) own tasks; ``kill`` cancels every task on
+    the node so their cleanup runs, bumps the node epoch, and resets each
+    registered simulator's per-node state (task.rs:255-276).
+  * ``restart`` = kill + re-run the node's stored init coroutine
+    (task.rs:279-291); ``pause``/``resume`` stash and release ready tasks
+    (task.rs:294-314).
+  * a panicking task on a ``restart_on_panic`` node is caught and the node
+    restarts after a random 1-10 s delay (task.rs:187-206); a panic in an
+    un-awaited task anywhere else fails the whole simulation, matching the
+    reference where the unwind propagates through ``block_on``.
+
+The reference also interposes ``sched_getaffinity``/``sysconf``/
+``pthread_attr_init`` and *forbids thread creation* inside a simulation
+(task.rs:659-725); our analog lives in
+:mod:`madsim_tpu.runtime.intercept` (thread-spawn guard + per-node
+``available_parallelism``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Coroutine, Optional
+
+from . import context
+from .future import SimFuture
+from .mpsc import RandomQueue
+from .rand import GlobalRng
+from .time_ import TimeRuntime
+
+__all__ = [
+    "Executor",
+    "NodeInfo",
+    "Task",
+    "JoinHandle",
+    "JoinError",
+    "DeadlockError",
+    "TimeLimitError",
+    "spawn",
+    "spawn_local",
+]
+
+MAIN_NODE_ID = 0
+
+
+class JoinError(Exception):
+    """Awaiting a killed/aborted task (analog of task.rs:611 JoinError)."""
+
+
+class DeadlockError(RuntimeError):
+    """No runnable task and no pending timer (task.rs:164)."""
+
+
+class TimeLimitError(RuntimeError):
+    """Virtual time exceeded the configured limit (task.rs:165-171)."""
+
+
+class NodeInfo:
+    """Per-node bookkeeping. Killing a node retires this object and installs
+    a fresh one under the same id — the epoch semantics of task.rs:255-276
+    (stale tasks still point at the retired info and get dropped)."""
+
+    __slots__ = (
+        "id",
+        "name",
+        "ip",
+        "cores",
+        "init",
+        "restart_on_panic",
+        "killed",
+        "paused",
+        "paused_tasks",
+        "tasks",
+    )
+
+    def __init__(
+        self,
+        node_id: int,
+        name: str,
+        init: Optional[Callable[[], Coroutine]] = None,
+        restart_on_panic: bool = False,
+        cores: int = 1,
+        ip: Optional[str] = None,
+    ):
+        self.id = node_id
+        self.name = name
+        self.ip = ip
+        self.cores = cores
+        self.init = init
+        self.restart_on_panic = restart_on_panic
+        self.killed = False
+        self.paused = False
+        self.paused_tasks: list[Task] = []
+        self.tasks: list[Task] = []
+
+    def __repr__(self) -> str:
+        return f"NodeInfo(id={self.id}, name={self.name!r})"
+
+
+class Task:
+    __slots__ = (
+        "id",
+        "coro",
+        "node",
+        "name",
+        "_fut",
+        "scheduled",
+        "finished",
+        "_close_pending",
+    )
+
+    def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo, name: str):
+        self.id = task_id
+        self.coro = coro
+        self.node = node
+        self.name = name
+        self._fut = SimFuture(name=f"join:{name}")
+        self.scheduled = False
+        self.finished = False
+        self._close_pending = False
+
+    def kill(self) -> None:
+        """Cancel: close the coroutine (finally blocks run — the analog of
+        dropping the future, task.rs:270-271) and fail the join future."""
+        if self.finished:
+            return
+        self.finished = True
+        try:
+            self.coro.close()
+        except (ValueError, RuntimeError):
+            # A task killing itself (or its own node) mid-poll: the
+            # coroutine is currently running and cannot be closed here.
+            # The executor closes it at the task's next suspension point
+            # so its finally-block cleanup still runs.
+            self._close_pending = True
+        self._fut.set_exception(JoinError(f"task {self.name!r} was killed"))
+
+    def __repr__(self) -> str:
+        return f"Task(id={self.id}, name={self.name!r}, node={self.node.id})"
+
+
+class JoinHandle:
+    """Handle to a spawned task (task.rs:569-609)."""
+
+    __slots__ = ("_task",)
+
+    def __init__(self, task: Task):
+        self._task = task
+
+    @property
+    def _fut(self) -> SimFuture:
+        return self._task._fut
+
+    def __await__(self):
+        return self._task._fut.__await__()
+
+    def done(self) -> bool:
+        return self._task.finished
+
+    def abort(self) -> None:
+        """Cancel the task (tokio-style abort; kill-drops-future semantics)."""
+        self._task.kill()
+
+    # tokio parity alias
+    cancel = abort
+
+
+class Executor:
+    """Single-threaded discrete-event executor (task.rs:33-216)."""
+
+    def __init__(self, rng: GlobalRng, time: TimeRuntime):
+        self.rng = rng
+        self.time = time
+        self.queue: RandomQueue[Task] = RandomQueue()
+        self.nodes: dict[int, NodeInfo] = {}
+        self.main_node = NodeInfo(MAIN_NODE_ID, "main")
+        self.nodes[MAIN_NODE_ID] = self.main_node
+        self._next_node_id = 1
+        self._next_task_id = 1
+        self.time_limit_ns: Optional[int] = None
+        # list of Simulator instances, installed by Runtime; consulted on
+        # node create/reset (runtime/mod.rs:68-79 sims registry).
+        self.simulators: list = []
+        self._pending_panic: Optional[BaseException] = None
+
+    # ---- spawning -------------------------------------------------------
+    def spawn_on(self, node: NodeInfo, coro: Coroutine, name: str = "") -> JoinHandle:
+        if node.killed:
+            coro.close()
+            raise RuntimeError(f"cannot spawn on killed node {node.id}")
+        task = Task(self._next_task_id, coro, node, name or coro.__name__)
+        self._next_task_id += 1
+        node.tasks.append(task)
+        self._schedule(task)
+        return JoinHandle(task)
+
+    def _schedule(self, task: Task) -> None:
+        if not task.finished and not task.scheduled:
+            task.scheduled = True
+            self.queue.push(task)
+
+    def _waker(self, task: Task) -> Callable[[], None]:
+        return lambda: self._schedule(task)
+
+    # ---- the hot loop ---------------------------------------------------
+    def block_on(self, coro: Coroutine) -> Any:
+        main = self.spawn_on(self.main_node, coro, "main")
+        main_fut = main._fut
+        while True:
+            self.run_all_ready()
+            if self._pending_panic is not None:
+                exc, self._pending_panic = self._pending_panic, None
+                raise exc
+            if main_fut.done():
+                return main_fut.result()
+            if not self.time.advance_to_next_event():
+                raise DeadlockError(
+                    "all tasks will block forever: no runnable task and no "
+                    "pending timer event"
+                )
+            if self.time_limit_ns is not None and self.time.now_ns() > self.time_limit_ns:
+                raise TimeLimitError(
+                    f"time limit of {self.time_limit_ns / 1e9}s exceeded"
+                )
+
+    def run_all_ready(self) -> None:
+        """Drain the ready queue in random order (task.rs:176-216)."""
+        while True:
+            task = self.queue.try_pop_random(self.rng)
+            if task is None:
+                return
+            task.scheduled = False
+            if task.finished:
+                continue
+            node = task.node
+            if node.killed:
+                task.kill()
+                continue
+            if node.paused:
+                node.paused_tasks.append(task)
+                continue
+            self._poll(task)
+            # Each poll costs a random 50-100 ns of virtual time
+            # (task.rs:213-214).
+            self.time.advance(self.rng.randrange(50, 100))
+
+    def _poll(self, task: Task) -> None:
+        try:
+            with context.enter_task(task):
+                yielded = task.coro.send(None)
+        except StopIteration as stop:
+            task.finished = True
+            task._fut.set_result(stop.value)
+        except BaseException as exc:  # noqa: BLE001 - panic path
+            self._on_panic(task, exc)
+        else:
+            if task._close_pending:
+                # The task was killed during its own poll (self-kill); now
+                # that it is suspended, drop it so finally blocks run.
+                task._close_pending = False
+                try:
+                    task.coro.close()
+                except RuntimeError:
+                    pass
+                return
+            if not isinstance(yielded, SimFuture):
+                task.finished = True
+                err = TypeError(
+                    f"task {task.name!r} awaited a non-simulation awaitable "
+                    f"({type(yielded).__name__}); only madsim_tpu futures "
+                    f"can be awaited inside the simulator"
+                )
+                self._pending_panic = err
+                return
+            if task.node.killed:
+                task.kill()
+            else:
+                yielded.add_waker(self._waker(task))
+
+    def _on_panic(self, task: Task, exc: BaseException) -> None:
+        task.finished = True
+        node = task.node
+        if node.restart_on_panic and node.id != MAIN_NODE_ID:
+            # Kill the node *immediately* (sibling tasks stop, simulator
+            # per-node state resets), then restart after a random 1-10 s
+            # delay (task.rs:187-206, runtime/mod.rs:319-325).
+            delay_ns = self.rng.randrange(1_000_000_000, 10_000_000_000)
+            node_id = node.id
+            task._fut.set_exception(JoinError(f"task {task.name!r} panicked: {exc!r}"))
+            self.kill_node(node_id)
+            self.time.add_timer_at(
+                self.time.now_ns() + delay_ns,
+                lambda: self.restart_node(node_id),
+            )
+            return
+        # A panic in any other task fails the whole simulation, exactly like
+        # the reference where the unwind propagates through block_on. (To
+        # handle expected errors, return them as values from the task.)
+        # This is deliberately independent of whether anyone is awaiting the
+        # JoinHandle — error routing must not depend on scheduling order.
+        task._fut.set_exception(JoinError(f"task {task.name!r} panicked"))
+        self._pending_panic = exc
+
+    # ---- node lifecycle (task.rs:255-332) -------------------------------
+    def create_node(
+        self,
+        name: Optional[str] = None,
+        init: Optional[Callable[[], Coroutine]] = None,
+        restart_on_panic: bool = False,
+        cores: int = 1,
+        ip: Optional[str] = None,
+    ) -> NodeInfo:
+        node_id = self._next_node_id
+        self._next_node_id += 1
+        info = NodeInfo(node_id, name or f"node-{node_id}", init, restart_on_panic, cores, ip)
+        self.nodes[node_id] = info
+        for sim in self.simulators:
+            sim.create_node(node_id)
+        return info
+
+    def _retire(self, info: NodeInfo) -> NodeInfo:
+        info.killed = True
+        for t in list(info.tasks):
+            t.kill()
+        info.tasks.clear()
+        info.paused_tasks.clear()
+        fresh = NodeInfo(
+            info.id, info.name, info.init, info.restart_on_panic, info.cores, info.ip
+        )
+        self.nodes[info.id] = fresh
+        for sim in self.simulators:
+            sim.reset_node(info.id)
+        return fresh
+
+    def kill_node(self, node_id: int) -> None:
+        if node_id == MAIN_NODE_ID:
+            raise ValueError("cannot kill the main node")
+        self._retire(self.nodes[node_id])
+
+    def restart_node(self, node_id: int) -> None:
+        if node_id == MAIN_NODE_ID:
+            raise ValueError("cannot restart the main node")
+        fresh = self._retire(self.nodes[node_id])
+        if fresh.init is not None:
+            self.spawn_on(fresh, fresh.init(), name=f"init:{fresh.name}")
+
+    def pause_node(self, node_id: int) -> None:
+        if node_id == MAIN_NODE_ID:
+            raise ValueError("cannot pause the main node")
+        self.nodes[node_id].paused = True
+
+    def resume_node(self, node_id: int) -> None:
+        info = self.nodes[node_id]
+        info.paused = False
+        for t in info.paused_tasks:
+            self._schedule(t)
+        info.paused_tasks.clear()
+
+
+# ---- free functions -----------------------------------------------------
+
+
+def spawn(coro: Coroutine, name: str = "") -> JoinHandle:
+    """Spawn a task on the current node (task.rs:480-488)."""
+    handle = context.current_handle()
+    cur = context.try_current_task()
+    node = cur.node if cur is not None else handle.executor.main_node
+    return handle.executor.spawn_on(node, coro, name)
+
+
+def spawn_local(coro: Coroutine, name: str = "") -> JoinHandle:
+    """Alias of :func:`spawn` — the whole simulation is single-threaded
+    (task.rs:490-497)."""
+    return spawn(coro, name)

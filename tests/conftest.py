@@ -1,0 +1,16 @@
+"""Test-session environment.
+
+JAX tests run on a virtual 8-device CPU platform so multi-chip sharding
+(seed-axis shard_map over a Mesh) is exercised without TPU hardware; the
+driver separately dry-runs the multi-chip path via __graft_entry__.py.
+Must be set before the first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

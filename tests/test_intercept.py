@@ -1,0 +1,119 @@
+"""Determinism substrate: stdlib interposition inside the sim context
+(the analog of the reference's libc overrides — rand.rs:174-240,
+system_time.rs:6-109, task.rs:711-725)."""
+
+import os
+import random
+import threading
+import time
+import uuid
+
+import pytest
+
+import madsim_tpu as ms
+
+
+def _run(seed, coro_fn):
+    return ms.Runtime(seed=seed).block_on(coro_fn())
+
+
+def test_stdlib_random_is_deterministic_per_seed():
+    async def wl():
+        return [random.random() for _ in range(5)] + [random.randint(0, 10**9)]
+
+    assert _run(42, wl) == _run(42, wl)
+    assert _run(42, wl) != _run(43, wl)
+
+
+def test_os_urandom_and_uuid_deterministic():
+    async def wl():
+        return os.urandom(16), str(uuid.uuid4())
+
+    assert _run(7, wl) == _run(7, wl)
+    assert _run(7, wl) != _run(8, wl)
+
+
+def test_time_time_is_simulated():
+    async def wl():
+        t0 = time.time()
+        await ms.sleep(5.0)
+        return t0, time.time()
+
+    t0, t1 = _run(3, wl)
+    assert 1_640_995_200 <= t0 <= 1_672_531_200  # year 2022
+    assert 4.9 < t1 - t0 < 5.1
+
+
+def test_monotonic_is_simulated():
+    async def wl():
+        m0 = time.monotonic()
+        await ms.sleep(2.0)
+        return time.monotonic() - m0
+
+    assert 1.9 < _run(3, wl) < 2.1
+
+
+def test_blocking_sleep_advances_virtual_clock():
+    async def wl():
+        m0 = time.monotonic_ns()
+        time.sleep(1.5)  # must not block real time
+        return time.monotonic_ns() - m0
+
+    assert _run(3, wl) == 1_500_000_000
+
+
+def test_threads_forbidden_in_simulation():
+    async def wl():
+        t = threading.Thread(target=lambda: None)
+        with pytest.raises(RuntimeError, match="cannot create system threads"):
+            t.start()
+        return True
+
+    assert _run(1, wl)
+
+
+def test_random_seed_forbidden_in_simulation():
+    async def wl():
+        with pytest.raises(RuntimeError, match="forbidden"):
+            random.seed(0)
+        return True
+
+    assert _run(1, wl)
+
+
+def test_outside_sim_stdlib_untouched():
+    # Dispatchers fall through to the real implementations off-thread
+    # (the dlsym(RTLD_NEXT) analog).
+    ms.Runtime(seed=1).block_on(_noop())
+    now = time.time()
+    assert now > 1_700_000_000  # real present-day clock, not year 2022
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    random.seed(123)
+    a = random.random()
+    random.seed(123)
+    assert random.random() == a
+
+
+async def _noop():
+    return None
+
+
+def test_available_parallelism_reflects_node_cores():
+    async def wl():
+        h = ms.Handle.current()
+        got = {}
+
+        async def probe():
+            got["cores"] = ms.available_parallelism()
+            got["cpu_count"] = os.cpu_count()
+
+        node = h.create_node().cores(4).build()
+        node.spawn(probe())
+        await ms.sleep(1.0)
+        return got
+
+    got = _run(1, wl)
+    assert got["cores"] == 4
+    assert got["cpu_count"] == 4

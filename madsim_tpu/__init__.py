@@ -65,4 +65,12 @@ from .runtime import (  # noqa: F401
     timeout,
 )
 
+# Importing the device-simulator packages registers them as default
+# simulators on every Runtime (reference runtime/mod.rs:62-64).
+from . import fs  # noqa: E402,F401
+from . import net  # noqa: E402,F401
+from . import sync  # noqa: E402,F401
+from .fs import FsSim  # noqa: F401
+from .net import Endpoint, NetSim, TcpListener, TcpStream, UdpSocket  # noqa: F401
+
 __version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Simulated network stack (reference: madsim/src/sim/net/)."""
+
+from ..runtime.runtime import DEFAULT_SIMULATORS
+from .addr import SocketAddr, lookup_host, parse_addr
+from .endpoint import Endpoint, PipeReceiver, PipeSender
+from .netsim import NetSim
+from .network import Network, Stat
+from .rpc import add_rpc_handler, add_rpc_handler_with_data, call, call_with_data, rpc_id
+from .tcp import TcpListener, TcpStream
+from .udp import UdpSocket
+
+if NetSim not in DEFAULT_SIMULATORS:
+    DEFAULT_SIMULATORS.append(NetSim)
+
+__all__ = [
+    "Endpoint",
+    "NetSim",
+    "TcpListener",
+    "TcpStream",
+    "UdpSocket",
+    "Network",
+    "PipeReceiver",
+    "PipeSender",
+    "SocketAddr",
+    "Stat",
+    "add_rpc_handler",
+    "add_rpc_handler_with_data",
+    "call",
+    "call_with_data",
+    "lookup_host",
+    "parse_addr",
+    "rpc_id",
+]

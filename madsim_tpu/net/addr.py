@@ -1,0 +1,41 @@
+"""Deterministic address parsing/resolution.
+
+Parity with reference madsim/src/sim/net/addr.rs: a synchronous,
+deterministic resolver — no real DNS. ``"localhost"`` maps to 127.0.0.1
+(addr.rs:1-80); accepted forms are ``"ip:port"`` strings, ``(ip, port)``
+tuples, and already-parsed :class:`SocketAddr`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Tuple, Union
+
+__all__ = ["SocketAddr", "parse_addr", "lookup_host", "AddrLike"]
+
+SocketAddr = Tuple[str, int]
+AddrLike = Union[str, SocketAddr]
+
+_ALIASES = {"localhost": "127.0.0.1", "": "0.0.0.0", "*": "0.0.0.0"}
+
+
+def _canon_ip(ip: str) -> str:
+    return _ALIASES.get(ip, ip)
+
+
+def parse_addr(addr: AddrLike) -> SocketAddr:
+    """Parse an address into a canonical ``(ip, port)`` tuple."""
+    if isinstance(addr, tuple):
+        ip, port = addr
+        return (_canon_ip(str(ip)), int(port))
+    if isinstance(addr, str):
+        if ":" not in addr:
+            raise ValueError(f"invalid socket address {addr!r}: expected 'ip:port'")
+        host, _, port_s = addr.rpartition(":")
+        return (_canon_ip(host), int(port_s))
+    raise TypeError(f"cannot parse address from {type(addr).__name__}")
+
+
+async def lookup_host(host: AddrLike) -> Iterable[SocketAddr]:
+    """Deterministic hostname resolution (addr.rs:32): returns the single
+    canonical address; never touches real DNS."""
+    return [parse_addr(host)]

@@ -17,10 +17,17 @@ __all__ = ["Simulator", "simulator", "node"]
 
 
 class Simulator:
-    """Base class for device simulators (NetSim, FsSim, user plugins)."""
+    """Base class for device simulators (NetSim, FsSim, user plugins).
 
-    def __init__(self, rng, time, config):
-        pass
+    Constructed once per runtime with the runtime's rng/time/config plus
+    the supervisor handle (the reference passes the Handle into
+    ``Simulator::new``, plugin.rs:20-24)."""
+
+    def __init__(self, rng, time, config, handle):
+        self.rng = rng
+        self.time = time
+        self.config = config
+        self.handle = handle
 
     def create_node(self, node_id: int) -> None:  # noqa: B027 - optional hook
         pass

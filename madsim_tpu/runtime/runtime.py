@@ -189,7 +189,7 @@ class Runtime:
     def add_simulator(self, cls: Type[S]) -> S:
         """Register a device simulator (mod.rs:68-79). Existing nodes get
         their ``create_node`` callback immediately."""
-        sim = cls(self.rng, self.time, self.config)
+        sim = cls(self.rng, self.time, self.config, self.handle)
         self.handle.sims[cls] = sim
         self.executor.simulators = list(self.handle.sims.values())
         for node_id in self.executor.nodes:

@@ -1,0 +1,541 @@
+"""Network simulator semantics, mirroring the reference's endpoint/net
+tests (SURVEY.md §4: sim/net/endpoint.rs:355-576, sim/net/tcp/mod.rs:
+98-208) — tag matching, partitions and recovery, node reset EOF, RPC."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.net import Endpoint, NetSim
+
+
+def run(seed, coro_fn, config=None, time_limit=60.0):
+    rt = ms.Runtime(seed=seed, config=config)
+    rt.set_time_limit(time_limit)
+    return rt.block_on(coro_fn())
+
+
+def two_nodes(h):
+    a = h.create_node().name("a").ip("10.0.0.1").build()
+    b = h.create_node().name("b").ip("10.0.0.2").build()
+    return a, b
+
+
+def test_endpoint_send_recv_across_nodes():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        got = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            payload, src = await ep.recv_from(tag=7)
+            got.set_result((payload, src))
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.0.0.2:500", 7, {"hello": "world"})
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        payload, src = await got
+        assert payload == {"hello": "world"}
+        assert src[0] == "10.0.0.1"
+        return True
+
+    assert run(1, main)
+
+
+def test_tag_matching_order_independent():
+    """Receivers get messages by tag regardless of arrival order
+    (endpoint.rs tag-matching tests)."""
+
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        done = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:500")
+            # wait for tag 2 first even though tag 1 arrives first
+            p2, _ = await ep.recv_from(tag=2)
+            p1, _ = await ep.recv_from(tag=1)
+            done.set_result((p1, p2))
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.0.0.2:500", 1, "one")
+            await ms.sleep(0.5)
+            await ep.send_to("10.0.0.2:500", 2, "two")
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        assert await done == ("one", "two")
+        return True
+
+    assert run(2, main)
+
+
+def test_connection_ordered_delivery():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        out = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:600")
+            _tx, rx, peer = await ep.accept1()
+            got = [await rx.recv() for _ in range(50)]
+            out.set_result((got, peer))
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            tx, _rx = await ep.connect1("10.0.0.2:600")
+            for i in range(50):
+                await tx.send(i)
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        got, peer = await out
+        assert got == list(range(50))  # reliable AND ordered
+        assert peer[0] == "10.0.0.1"
+        return True
+
+    assert run(3, main)
+
+
+def test_connection_refused():
+    async def main():
+        h = ms.Handle.current()
+        a, _b = two_nodes(h)
+        result = ms.SimFuture()
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            try:
+                await ep.connect1("10.0.0.2:9999")  # nothing bound there
+            except ConnectionRefusedError:
+                result.set_result("refused")
+
+        a.spawn(client())
+        assert await result == "refused"
+        return True
+
+    assert run(4, main)
+
+
+def test_partition_stalls_connection_and_recovers():
+    """clog_link blocks the stream; unclog resumes it in order
+    (reference tcp/mod.rs:98-174 partition-and-recovery phases)."""
+
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        received = []
+        ready = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:700")
+            ready.set_result(None)
+            _tx, rx, _ = await ep.accept1()
+            while True:
+                m = await rx.recv()
+                if m is None:
+                    return
+                received.append((m, round(ms.now_ns() / 1e9, 1)))
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            tx, _ = await ep.connect1("10.0.0.2:700")
+            await tx.send("before")
+            await ms.sleep(1.0)
+            # partition happens at t~1; these stall
+            await tx.send("during-1")
+            await tx.send("during-2")
+
+        b.spawn(server())
+        await ready
+        a.spawn(client())
+        await ms.sleep(1.0)
+        net.clog_link(a, b)
+        await ms.sleep(10.0)
+        n_during = len(received)
+        net.unclog_link(a, b)
+        await ms.sleep(15.0)
+        assert [m for m, _ in received] == ["before", "during-1", "during-2"]
+        assert n_during == 1  # only "before" got through while clogged
+        return True
+
+    assert run(5, main)
+
+
+def test_packet_loss_drops_datagrams():
+    cfg = ms.Config()
+    cfg.net.packet_loss_rate = 1.0
+
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        got = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:800")
+            payload, _ = await ep.recv_from(tag=1)
+            got.set_result(payload)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            for _ in range(20):
+                await ep.send_to("10.0.0.2:800", 1, "x")
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        with pytest.raises(ms.Elapsed):
+            await ms.timeout(30.0, _await_future(got))
+        return True
+
+    assert run(6, main, config=cfg, time_limit=120.0)
+
+
+async def _await_future(fut):
+    return await fut
+
+
+def test_kill_server_gives_eof_and_send_error():
+    """Node reset closes connections: peer recv -> EOF, send -> error
+    (reference tcp/mod.rs:176-208)."""
+
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        status = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:900")
+            _tx, rx, _ = await ep.accept1()
+            await rx.recv()  # keep the conn alive
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            tx, rx = await ep.connect1("10.0.0.2:900")
+            await tx.send("hi")
+            eof = await rx.recv()  # blocks until server dies -> EOF
+            assert eof is None
+            try:
+                await tx.send("again")
+                status.set_result("send-succeeded")
+            except ConnectionResetError:
+                status.set_result("send-failed-after-reset")
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        await ms.sleep(2.0)
+        h.kill(b)
+        assert await status == "send-failed-after-reset"
+        return True
+
+    assert run(7, main)
+
+
+class Echo:
+    def __init__(self, text):
+        self.text = text
+
+
+class Fail:
+    pass
+
+
+def test_rpc_echo_and_error_propagation():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        srv_ready = ms.SimFuture()
+        result = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:1000")
+
+            async def on_echo(req):
+                return f"echo: {req.text}"
+
+            async def on_fail(_req):
+                raise ValueError("handler exploded")
+
+            ep.add_rpc_handler(Echo, on_echo)
+            ep.add_rpc_handler(Fail, on_fail)
+            srv_ready.set_result(None)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            r = await ep.call("10.0.0.2:1000", Echo("hi"))
+            try:
+                await ep.call("10.0.0.2:1000", Fail())
+                result.set_result((r, "no-error"))
+            except ValueError as e:
+                result.set_result((r, str(e)))
+
+        b.spawn(server())
+        await srv_ready
+        a.spawn(client())
+        assert await result == ("echo: hi", "handler exploded")
+        return True
+
+    assert run(8, main)
+
+
+def test_rpc_timeout_on_clogged_node():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        result = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:1100")
+
+            async def on_echo(req):
+                return req.text
+
+            ep.add_rpc_handler(Echo, on_echo)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            try:
+                await ep.call("10.0.0.2:1100", Echo("x"), timeout=5.0)
+                result.set_result("ok")
+            except ms.Elapsed:
+                result.set_result("timeout")
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        net.clog_node(b)
+        a.spawn(client())
+        assert await result == "timeout"
+        return True
+
+    assert run(9, main)
+
+
+def test_send_hook_drops_matching_messages():
+    """The RPC-drop chaos hook (reference net/mod.rs:223-262)."""
+
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        got = []
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:1200")
+            while True:
+                payload, _ = await ep.recv_from(tag=1)
+                got.append(payload)
+
+        def drop_evens(_src, _dst, msg):
+            if msg[0] == "dgram" and isinstance(msg[2], int) and msg[2] % 2 == 0:
+                return False
+            return True
+
+        hook_id = net.add_send_hook(drop_evens)
+        b.spawn(server())
+        await ms.sleep(0.1)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            for i in range(6):
+                await ep.send_to("10.0.0.2:1200", 1, i)
+
+        a.spawn(client())
+        await ms.sleep(5.0)
+        net.remove_send_hook(hook_id)
+        assert sorted(got) == [1, 3, 5]
+        return True
+
+    assert run(10, main)
+
+
+def test_stat_counts_messages():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:1300")
+            while True:
+                await ep.recv_from(tag=1)
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            for _ in range(5):
+                await ep.send_to("10.0.0.2:1300", 1, "m")
+
+        a.spawn(client())
+        await ms.sleep(5.0)
+        assert net.stat.msg_count == 5
+        return True
+
+    assert run(11, main)
+
+
+def test_ephemeral_port_allocation():
+    async def main():
+        h = ms.Handle.current()
+        a, _ = two_nodes(h)
+        ports = ms.SimFuture()
+
+        async def t():
+            e1 = await Endpoint.bind("0.0.0.0:0")
+            e2 = await Endpoint.bind("0.0.0.0:0")
+            ports.set_result((e1.local_addr[1], e2.local_addr[1]))
+
+        a.spawn(t())
+        p1, p2 = await ports
+        assert p1 != p2
+        assert p1 >= 0x8000 and p2 >= 0x8000
+        return True
+
+    assert run(12, main)
+
+
+def test_localhost_isolation():
+    """127.0.0.1 resolves to the sender's own node — two nodes' loopback
+    endpoints do not see each other (endpoint.rs localhost tests)."""
+
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        got_a, got_b = [], []
+
+        async def local_server(sink):
+            ep = await Endpoint.bind("127.0.0.1:1400")
+            while True:
+                p, _ = await ep.recv_from(tag=1)
+                sink.append(p)
+
+        async def local_client(tag_val):
+            ep = await Endpoint.bind("127.0.0.1:0")
+            await ep.send_to("127.0.0.1:1400", 1, tag_val)
+
+        a.spawn(local_server(got_a))
+        b.spawn(local_server(got_b))
+        await ms.sleep(0.1)
+        a.spawn(local_client("from-a"))
+        b.spawn(local_client("from-b"))
+        await ms.sleep(5.0)
+        assert got_a == ["from-a"]
+        assert got_b == ["from-b"]
+        return True
+
+    assert run(13, main)
+
+
+def test_tcp_udp_endpoint_port_namespaces_coexist():
+    """Sockets are keyed by (addr, protocol): UDP, TCP and Endpoint can
+    share a port number (reference network.rs:24-70)."""
+    from madsim_tpu.net import TcpListener, UdpSocket
+
+    async def main():
+        h = ms.Handle.current()
+        a, _ = two_nodes(h)
+        out = ms.SimFuture()
+
+        async def t():
+            await UdpSocket.bind("0.0.0.0:53")
+            await TcpListener.bind("0.0.0.0:53")
+            await Endpoint.bind("0.0.0.0:53")
+            out.set_result("all-bound")
+
+        a.spawn(t())
+        assert await out == "all-bound"
+        return True
+
+    assert run(20, main)
+
+
+def test_send_without_ip_fails_loudly():
+    """A node without an IP cannot address remote peers; the error must be
+    immediate, not a silently-misrouted reply."""
+
+    async def main():
+        h = ms.Handle.current()
+        _a, _b = two_nodes(h)
+        # main node (node 0) has no IP
+        ep = await Endpoint.bind("0.0.0.0:0")
+        try:
+            await ep.send_to("10.0.0.2:500", 1, "x")
+            return "sent"
+        except OSError as e:
+            return "no-ip-error" if "no IP" in str(e) else f"other: {e}"
+
+    assert run(21, main) == "no-ip-error"
+
+
+def test_rpc_timeout_cleans_mailbox():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        done = ms.SimFuture()
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            net.clog_node(b)
+            for _ in range(10):
+                try:
+                    await ep.call("10.0.0.2:1", Echo("x"), timeout=1.0)
+                except ms.Elapsed:
+                    pass
+            done.set_result(len(ep._mailbox.waiters) + len(ep._mailbox.msgs))
+
+        a.spawn(client())
+        assert await done == 0
+        return True
+
+    assert run(22, main, time_limit=120.0)
+
+
+def test_pipe_registry_does_not_grow_across_connections():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:600")
+            while True:
+                _tx, rx, _ = await ep.accept1()
+
+                async def drain(rx=rx):
+                    while await rx.recv() is not None:
+                        pass
+
+                ms.spawn(drain())
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            for _ in range(30):
+                tx, _rx = await ep.connect1("10.0.0.2:600")
+                await tx.send("hi")
+                tx.close()  # full close releases both directions
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        await a.spawn(client())
+        await ms.sleep(30.0)
+        n_live = sum(len(s) for s in net._pipes_by_node.values())
+        # each closed connection must deregister its pipes; only the last
+        # connection's reverse-direction pipes may linger
+        assert n_live <= 8, f"pipe registry leaked: {n_live} live entries"
+        return True
+
+    assert run(23, main, time_limit=240.0)

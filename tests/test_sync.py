@@ -1,0 +1,268 @@
+"""Deterministic sync primitives (the tokio::sync-surface analog,
+SURVEY.md §2 C21)."""
+
+import madsim_tpu as ms
+from madsim_tpu import sync
+
+
+def run(seed, coro_fn):
+    rt = ms.Runtime(seed=seed)
+    rt.set_time_limit(60.0)
+    return rt.block_on(coro_fn())
+
+
+def test_oneshot():
+    async def main():
+        tx, rx = sync.oneshot()
+
+        async def producer():
+            await ms.sleep(1.0)
+            tx.send(99)
+
+        ms.spawn(producer())
+        return await rx.recv()
+
+    assert run(1, main) == 99
+
+
+def test_mpsc_bounded_backpressure():
+    async def main():
+        tx, rx = sync.channel(capacity=2)
+        sent = []
+
+        async def producer():
+            for i in range(5):
+                await tx.send(i)
+                sent.append(i)
+
+        ms.spawn(producer())
+        await ms.sleep(1.0)
+        assert len(sent) <= 3  # 2 queued + 1 possibly in-flight
+        got = [await rx.recv() for _ in range(5)]
+        assert got == list(range(5))
+        return True
+
+    assert run(2, main)
+
+
+def test_mpsc_close_gives_none():
+    async def main():
+        tx, rx = sync.unbounded_channel()
+        await tx.send("a")
+        tx.close()
+        assert await rx.recv() == "a"
+        assert await rx.recv() is None
+        return True
+
+    assert run(3, main)
+
+
+def test_watch():
+    async def main():
+        tx, rx = sync.watch("v0")
+        seen = []
+
+        async def watcher():
+            while True:
+                await rx.changed()
+                seen.append(rx.borrow())
+                if rx.borrow() == "v2":
+                    return
+
+        jh = ms.spawn(watcher())
+        await ms.sleep(0.1)
+        tx.send("v1")
+        await ms.sleep(0.1)
+        tx.send("v2")
+        await jh
+        return seen
+
+    assert run(4, main) == ["v1", "v2"]
+
+
+def test_mutex_exclusion():
+    async def main():
+        m = sync.Mutex(0)
+        trace = []
+
+        async def worker(tag):
+            async with m:
+                trace.append((tag, "in"))
+                await ms.sleep(1.0)
+                trace.append((tag, "out"))
+
+        for t in range(3):
+            ms.spawn(worker(t))
+        await ms.sleep(10.0)
+        # critical sections never interleave
+        for i in range(0, len(trace), 2):
+            assert trace[i][0] == trace[i + 1][0]
+            assert trace[i][1] == "in" and trace[i + 1][1] == "out"
+        return len(trace)
+
+    assert run(5, main) == 6
+
+
+def test_rwlock_readers_shared_writer_exclusive():
+    async def main():
+        lock = sync.RwLock(0)
+        events = []
+
+        async def reader(tag):
+            async with await lock.read() as v:
+                events.append(("r", tag, v))
+                await ms.sleep(1.0)
+
+        async def writer():
+            async with await lock.write() as g:
+                g.value = 42
+                events.append(("w", None, g.value))
+                await ms.sleep(1.0)
+
+        ms.spawn(reader(1))
+        ms.spawn(reader(2))
+        await ms.sleep(0.1)
+        ms.spawn(writer())
+        await ms.sleep(5.0)
+
+        async with await lock.read() as v:
+            assert v == 42
+        # both readers entered before the writer
+        assert [e[0] for e in events] == ["r", "r", "w"]
+        return True
+
+    assert run(6, main)
+
+
+def test_semaphore_limits_concurrency():
+    async def main():
+        sem = sync.Semaphore(2)
+        active = {"n": 0, "max": 0}
+
+        async def worker():
+            async with sem:
+                active["n"] += 1
+                active["max"] = max(active["max"], active["n"])
+                await ms.sleep(1.0)
+                active["n"] -= 1
+
+        for _ in range(6):
+            ms.spawn(worker())
+        await ms.sleep(10.0)
+        assert active["max"] == 2
+        return True
+
+    assert run(7, main)
+
+
+def test_notify():
+    async def main():
+        n = sync.Notify()
+        woke = []
+
+        async def waiter(tag):
+            await n.notified()
+            woke.append(tag)
+
+        for t in range(3):
+            ms.spawn(waiter(t))
+        await ms.sleep(0.1)
+        n.notify_one()
+        await ms.sleep(0.1)
+        assert len(woke) == 1
+        n.notify_waiters()
+        await ms.sleep(0.1)
+        assert len(woke) == 3
+        return True
+
+    assert run(8, main)
+
+
+def test_barrier():
+    async def main():
+        b = sync.Barrier(3)
+        leaders = []
+
+        async def worker(delay):
+            await ms.sleep(delay)
+            leaders.append(await b.wait())
+
+        for d in (0.1, 0.5, 1.0):
+            ms.spawn(worker(d))
+        await ms.sleep(2.0)
+        assert sorted(leaders) == [False, False, True]
+        return True
+
+    assert run(9, main)
+
+
+def test_broadcast():
+    async def main():
+        tx = sync.broadcast()
+        r1, r2 = tx.subscribe(), tx.subscribe()
+        assert tx.send("x") == 2
+        assert await r1.recv() == "x"
+        assert await r2.recv() == "x"
+        return True
+
+    assert run(10, main)
+
+
+def test_semaphore_no_lost_wakeup():
+    """release must wake all waiters: a small waiter must not be stranded
+    behind a large one."""
+
+    async def main():
+        sem = sync.Semaphore(0)
+        done = []
+
+        async def big():
+            await sem.acquire(2)
+            done.append("big")
+
+        async def small():
+            await sem.acquire(1)
+            done.append("small")
+
+        ms.spawn(big())
+        await ms.sleep(0.1)
+        ms.spawn(small())
+        await ms.sleep(0.1)
+        sem.release(1)  # only small can proceed
+        await ms.sleep(1.0)
+        assert done == ["small"]
+        sem.release(2)
+        await ms.sleep(1.0)
+        assert "big" in done
+        return True
+
+    assert run(11, main)
+
+
+def test_rwlock_writer_not_starved():
+    """Write-preferring: overlapping readers must not starve a writer."""
+
+    async def main():
+        lock = sync.RwLock(0)
+        wrote = ms.SimFuture()
+
+        async def reader_loop(phase):
+            await ms.sleep(phase)
+            for _ in range(20):
+                async with await lock.read():
+                    await ms.sleep(1.0)
+
+        async def writer():
+            await ms.sleep(1.2)
+            async with await lock.write() as g:
+                g.value = 1
+                wrote.set_result(ms.now_ns())
+
+        ms.spawn(reader_loop(0.0))
+        ms.spawn(reader_loop(0.5))
+        ms.spawn(writer())
+        t = await wrote
+        assert t < 5e9  # acquired promptly, not after 20s of reads
+        return True
+
+    assert run(12, main)

@@ -1,0 +1,221 @@
+"""TCP/UDP stream sims and the filesystem simulator (SURVEY.md §2
+C13/C14/C17)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import fs
+from madsim_tpu.net import NetSim, TcpListener, TcpStream, UdpSocket
+
+
+def run(seed, coro_fn, time_limit=120.0):
+    rt = ms.Runtime(seed=seed)
+    rt.set_time_limit(time_limit)
+    return rt.block_on(coro_fn())
+
+
+def two_nodes(h):
+    a = h.create_node().name("a").ip("10.0.0.1").build()
+    b = h.create_node().name("b").ip("10.0.0.2").build()
+    return a, b
+
+
+def test_tcp_roundtrip_partial_reads():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        out = ms.SimFuture()
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:80")
+            stream, peer = await lis.accept()
+            data = await stream.read_exact(11)
+            await stream.write_all(b"pong:" + data)
+
+        async def client():
+            s = await TcpStream.connect("10.0.0.2:80")
+            await s.write(b"hello")  # buffered, not sent
+            await s.write(b" world")
+            await s.flush()  # sent as one chunk
+            r1 = await s.read(4)
+            rest = await s.read_exact(12)
+            out.set_result(r1 + rest)
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        assert await out == b"pong:hello world"
+        return True
+
+    assert run(1, main)
+
+
+def test_tcp_eof_on_node_reset():
+    """Reference tcp/mod.rs:176-208: node reset => EOF on the stream."""
+
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        got = ms.SimFuture()
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:80")
+            stream, _ = await lis.accept()
+            await stream.read(1)  # hold
+
+        async def client():
+            s = await TcpStream.connect("10.0.0.2:80")
+            r = await s.read(10)  # blocks until server dies
+            got.set_result(r)
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        await ms.sleep(2.0)
+        h.kill(b)
+        assert await got == b""
+        return True
+
+    assert run(2, main)
+
+
+def test_tcp_partition_and_recovery():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        received = []
+
+        async def server():
+            lis = await TcpListener.bind("0.0.0.0:80")
+            stream, _ = await lis.accept()
+            while True:
+                chunk = await stream.read(1024)
+                if not chunk:
+                    return
+                received.append(chunk)
+
+        async def client():
+            s = await TcpStream.connect("10.0.0.2:80")
+            await s.write_all(b"one")
+            await ms.sleep(2.0)
+            await s.write_all(b"two")  # sent while partitioned
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        await ms.sleep(1.0)
+        net.clog_link(a, b)
+        await ms.sleep(10.0)
+        assert received == [b"one"]
+        net.unclog_link(a, b)
+        await ms.sleep(15.0)
+        assert received == [b"one", b"two"]
+        return True
+
+    assert run(3, main)
+
+
+def test_udp_datagrams():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        got = ms.SimFuture()
+
+        async def server():
+            sock = await UdpSocket.bind("0.0.0.0:53")
+            data, src = await sock.recv_from()
+            await sock.send_to(b"resp:" + data, src)
+
+        async def client():
+            sock = await UdpSocket.bind("0.0.0.0:0")
+            await sock.connect("10.0.0.2:53")
+            await sock.send(b"query")
+            got.set_result(await sock.recv())
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        assert await got == b"resp:query"
+        return True
+
+    assert run(4, main)
+
+
+def test_fs_read_write_metadata():
+    async def main():
+        h = ms.Handle.current()
+        node = h.create_node().ip("10.0.0.1").build()
+        done = ms.SimFuture()
+
+        async def work():
+            f = await fs.File.create("/data/log")
+            await f.write_all_at(b"hello", 0)
+            await f.write_all_at(b"world", 5)
+            assert await f.read_at(10, 0) == b"helloworld"
+            assert (await f.metadata()).len == 10
+            await f.set_len(5)
+            assert await fs.read("/data/log") == b"hello"
+            with pytest.raises(FileNotFoundError):
+                await fs.File.open("/missing")
+            done.set_result(True)
+
+        node.spawn(work())
+        return await done
+
+    assert run(5, main)
+
+
+def test_fs_is_per_node():
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        done = ms.SimFuture()
+
+        async def on_a():
+            await fs.write("/shared", b"from-a")
+
+        async def on_b():
+            try:
+                await fs.read("/shared")
+                done.set_result("visible")
+            except FileNotFoundError:
+                done.set_result("isolated")
+
+        a.spawn(on_a())
+        await ms.sleep(0.5)
+        b.spawn(on_b())
+        return await done
+
+    assert run(6, main) == "isolated"
+
+
+def test_fs_power_failure_drops_unsynced():
+    """Power failure (node reset) rolls files back to the last sync_all —
+    the intended semantics of reference fs.rs:51."""
+
+    async def main():
+        h = ms.Handle.current()
+        node = h.create_node().ip("10.0.0.1").build()
+        phase1 = ms.SimFuture()
+        result = ms.SimFuture()
+
+        async def writer():
+            f = await fs.File.create("/db")
+            await f.write_all_at(b"durable", 0)
+            await f.sync_all()
+            await f.write_all_at(b"volatile", 7)
+            phase1.set_result(None)
+            await ms.sleep(100.0)
+
+        node.spawn(writer())
+        await phase1
+        h.kill(node)  # power failure
+
+        async def reader():
+            result.set_result(await fs.read("/db"))
+
+        node.spawn(reader())
+        return await result
+
+    assert run(7, main) == b"durable"

@@ -1,0 +1,65 @@
+"""Headline benchmark: simulated-seconds/sec/chip on batched raft election.
+
+Runs the north-star workload from BASELINE.md (config 4 shape): a large
+seed batch of 5-node raft leader elections advanced in lockstep by the
+XLA-compiled engine, on whatever accelerator the driver provides (one
+TPU chip under axon; CPU elsewhere). Prints exactly one JSON line:
+
+    {"metric": "sim_seconds_per_sec_per_chip", "value": N,
+     "unit": "sim_s/s/chip", "vs_baseline": N / 200000}
+
+vs_baseline is against the BASELINE.json north-star target of 200,000
+simulated-seconds/sec (65,536-seed batch on a TPU v4-8); per-chip
+normalization keeps the number comparable across slice sizes.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from madsim_tpu.engine import EngineConfig, make_init, make_run
+    from madsim_tpu.models import make_raft
+
+    n_seeds = int(os.environ.get("BENCH_SEEDS", "8192"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "600"))
+
+    wl = make_raft()
+    cfg = EngineConfig(pool_size=128, loss_p=0.02)
+    init = make_init(wl, cfg)
+    run = jax.jit(make_run(wl, cfg, n_steps))
+
+    state = init(np.arange(n_seeds, dtype=np.uint64))
+    # warm-up: compile (first TPU compile is slow; cached afterwards)
+    out = run(state)
+    jax.block_until_ready(out)
+
+    # timed run on a fresh, disjoint seed range
+    state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
+    t0 = time.perf_counter()
+    out = run(state)
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+
+    sim_seconds = float(np.asarray(out.now, dtype=np.float64).sum() / 1e9)
+    n_chips = max(jax.device_count(), 1)
+    value = sim_seconds / wall / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "sim_seconds_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "sim_s/s/chip",
+                "vs_baseline": round(value / 200_000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

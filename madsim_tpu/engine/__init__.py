@@ -1,0 +1,46 @@
+"""madsim_tpu.engine — the batched, XLA-compiled simulation core.
+
+This is the TPU-native inversion of the reference's architecture
+(SURVEY.md §7): instead of one OS thread per seeded run, simulation state
+lives in dense arrays with a leading seed axis and one compiled step
+function advances every seed in lockstep. See engine/core.py for the
+full design narrative and engine/rng.py for the counter-based RNG
+contract.
+
+Importing this package enables 64-bit mode in JAX: virtual time is exact
+int64 nanoseconds and trace hashes are uint64 — the integer disciplines
+that make cross-backend traces bit-identical. The heavy per-seed state
+(node state, event kinds/args, RNG) stays 32-bit.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .core import (  # noqa: E402,F401
+    FIRST_USER_KIND,
+    KIND_CLOG,
+    KIND_CLOG_NODE,
+    KIND_HALT,
+    KIND_KILL,
+    KIND_NOP,
+    KIND_RESTART,
+    KIND_UNCLOG,
+    KIND_UNCLOG_NODE,
+    EmitBuilder,
+    Emits,
+    EngineConfig,
+    HandlerCtx,
+    SimState,
+    Workload,
+    make_init,
+    make_run,
+    make_step,
+    user_kind,
+)
+from .rng import (  # noqa: E402,F401
+    Draw,
+    chance_threshold,
+    np_threefry2x32,
+    threefry2x32,
+)

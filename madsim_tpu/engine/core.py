@@ -1,0 +1,702 @@
+"""Batched discrete-event simulation core — the TPU path.
+
+The reference advances one seeded simulation per OS thread: a
+single-threaded executor pops ready tasks in random order, polls
+arbitrary futures, and jumps a virtual clock between timer events
+(reference madsim/src/sim/task.rs:142-216, time/mod.rs:45-60). This
+module inverts that architecture for TPUs: **simulation state is a pytree
+of dense arrays with a leading seed axis**, and one XLA-compiled step
+function advances *every* seed by one event in lockstep —
+``vmap`` over seeds, ``lax.scan`` over steps, ``shard_map``/``jit`` with
+``NamedSharding`` over device meshes (see madsim_tpu.parallel).
+
+Mapping from the reference's moving parts to array form:
+
+  reference (per run)                      engine (per seed row)
+  ---------------------------------------  --------------------------------
+  ready queue + timer wheel                one event pool (E slots): time,
+    (task.rs:176-216, time/mod.rs:45-60)   kind, dst, src, epoch, args
+  random ready-task pick (mpsc.rs:73-83)   per-event latency/cost draws
+                                           randomize order; argmin pops the
+                                           earliest event deterministically
+  50-100 ns poll cost (task.rs:213)        poll-cost draw added to the
+                                           clock after each dispatch
+  serial SmallRng (rand.rs:30-61)          counter-based threefry draws
+                                           keyed (seed, step, purpose)
+  NodeInfo epoch swap on kill              alive/epoch arrays; events carry
+    (task.rs:255-276)                      their target's epoch and are
+                                           dropped on mismatch
+  NetSim clog/loss/latency                 clog matrix (N,N); per-send loss
+    (network.rs:75-95, 268-276)            and latency draws; clogged
+                                           deliveries self-reschedule with
+                                           exponential backoff
+                                           (net/mod.rs:341-355 semantics)
+  user futures polled by the executor      user code is a **state
+                                           machine**: per-node int32 state
+                                           rows + pure handler functions
+                                           dispatched by ``lax.switch``
+
+The last row is the central design decision (SURVEY.md §7 hard part 1):
+XLA cannot trace arbitrary coroutines, so batched workloads are written
+as event handlers over integer node state. The asyncio-style frontend in
+madsim_tpu.runtime remains the ergonomic single-seed API; this engine is
+the scaling path, and workloads written for it get 10^4-10^5 seeds per
+chip.
+
+Everything in the hot path is integer arithmetic (int32/int64/uint32) —
+bit-identical across CPU and TPU backends, which makes the trace hash an
+exact cross-backend determinism check (the analog of the reference's
+replay checker, runtime/mod.rs:165-190).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .rng import (
+    PURPOSE_CLOG_JITTER,
+    PURPOSE_LATENCY,
+    PURPOSE_LOSS,
+    PURPOSE_POLL_COST,
+    Draw,
+    chance_threshold,
+)
+
+__all__ = [
+    "EngineConfig",
+    "Workload",
+    "SimState",
+    "Emits",
+    "EmitBuilder",
+    "HandlerCtx",
+    "KIND_KILL",
+    "KIND_RESTART",
+    "KIND_CLOG",
+    "KIND_UNCLOG",
+    "KIND_CLOG_NODE",
+    "KIND_UNCLOG_NODE",
+    "KIND_HALT",
+    "KIND_NOP",
+    "FIRST_USER_KIND",
+    "user_kind",
+    "make_init",
+    "make_step",
+    "make_run",
+]
+
+_INF_NS = np.int64(2**62)
+_TRACE_PRIME = np.uint64(0x100000001B3)
+_TRACE_MIX = np.uint64(0x9E3779B97F4A7C15)
+
+# ---------------------------------------------------------------------------
+# Event kinds. Engine kinds come first so user handler k has kind
+# FIRST_USER_KIND + k regardless of workload; handler 0 is by convention
+# on_init (run for every node at t=0 and again after RESTART).
+# ---------------------------------------------------------------------------
+KIND_KILL = 0  # args[0]=node          Handle::kill        (runtime/mod.rs:246)
+KIND_RESTART = 1  # args[0]=node       Handle::restart     (runtime/mod.rs:251)
+KIND_CLOG = 2  # args[0]=a args[1]=b   NetSim::clog_link   (net/mod.rs:157-216)
+KIND_UNCLOG = 3  # args[0]=a args[1]=b
+KIND_CLOG_NODE = 4  # args[0]=node     NetSim::clog_node
+KIND_UNCLOG_NODE = 5  # args[0]=node
+KIND_HALT = 6  # scenario complete: freeze this seed's instance
+KIND_NOP = 7
+FIRST_USER_KIND = 8
+
+
+def user_kind(i: int) -> int:
+    """Kind id of user handler ``i`` (handler 0 = on_init)."""
+    return FIRST_USER_KIND + i
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static simulation parameters (the analog of sim Config, config.rs:15).
+
+    All values participate in the config hash printed on failure so a
+    repro needs (seed, config) exactly like the reference
+    (runtime/mod.rs:193-200).
+    """
+
+    pool_size: int = 256  # E: max in-flight events per seed
+    lat_min_ns: int = 1_000_000  # network latency range, default 1-10 ms
+    lat_max_ns: int = 10_000_000  # (reference network.rs:84-90)
+    loss_p: float = 0.0  # packet loss rate (network.rs:75-95)
+    proc_min_ns: int = 50  # per-event processing cost
+    proc_max_ns: int = 100  # (task.rs:213)
+    clog_backoff_min_ns: int = 1_000_000  # clogged-delivery recheck backoff
+    clog_backoff_max_ns: int = 10_000_000_000  # 1 ms -> 10 s (net/mod.rs:341-355)
+    time_limit_ns: int = 0  # 0 = unlimited (set_time_limit, runtime/mod.rs:143)
+
+    @property
+    def loss_u32(self) -> int:
+        return chance_threshold(self.loss_p)
+
+    def hash(self) -> str:
+        """Stable hex hash of the config (config.rs:27-31 analog)."""
+        import hashlib
+
+        s = repr(dataclasses.astuple(self)).encode()
+        return hashlib.sha256(s).hexdigest()[:16]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Emits:
+    """Fixed-capacity batch of events a handler emits (K slots).
+
+    ``send`` slots are translated by the engine into future deliveries
+    (latency + loss + clog, the NetSim path in SURVEY §3.3); timer slots
+    become plain future events (add_timer, time/mod.rs:138-149).
+    """
+
+    valid: jnp.ndarray  # (K,)  bool
+    send: jnp.ndarray  # (K,)  bool: network message vs local timer
+    kind: jnp.ndarray  # (K,)  int32
+    dst: jnp.ndarray  # (K,)  int32
+    delay: jnp.ndarray  # (K,)  int64 ns (timer) / ignored for sends
+    args: jnp.ndarray  # (K,4) int32
+
+    @staticmethod
+    def none(k: int) -> "Emits":
+        return Emits(
+            valid=jnp.zeros((k,), jnp.bool_),
+            send=jnp.zeros((k,), jnp.bool_),
+            kind=jnp.zeros((k,), jnp.int32),
+            dst=jnp.zeros((k,), jnp.int32),
+            delay=jnp.zeros((k,), jnp.int64),
+            args=jnp.zeros((k, 4), jnp.int32),
+        )
+
+
+class EmitBuilder:
+    """Trace-time helper for constructing :class:`Emits` inside handlers.
+
+    Slot assignment happens at Python trace time (static); the ``when``
+    flag is the traced per-seed condition making an emit conditional.
+    """
+
+    def __init__(self, k: int):
+        self._k = k
+        self._rows: list[tuple] = []
+
+    def _push(self, send, kind, dst, delay, args, when):
+        if len(self._rows) >= self._k:
+            raise ValueError(
+                f"handler emits more than max_emits={self._k} events; "
+                f"raise Workload.max_emits"
+            )
+        a = list(args) + [0] * (4 - len(args))
+        self._rows.append((when, send, kind, dst, delay, a))
+
+    def send(self, dst, kind, args=(), when=True):
+        """Send a network message: delivery after latency unless lost/clogged."""
+        self._push(True, kind, dst, 0, args, when)
+
+    def after(self, delay_ns, kind, dst, args=(), when=True):
+        """Schedule a local event ``delay_ns`` in the future (a timer)."""
+        self._push(False, kind, dst, delay_ns, args, when)
+
+    def kill(self, node, when=True):
+        self.after(0, KIND_KILL, 0, (node,), when)
+
+    def restart(self, node, when=True):
+        self.after(0, KIND_RESTART, 0, (node,), when)
+
+    def restart_after(self, delay_ns, node, when=True):
+        self.after(delay_ns, KIND_RESTART, 0, (node,), when)
+
+    def clog_link(self, a, b, when=True):
+        self.after(0, KIND_CLOG, 0, (a, b), when)
+
+    def unclog_link(self, a, b, when=True):
+        self.after(0, KIND_UNCLOG, 0, (a, b), when)
+
+    def halt(self, when=True):
+        self.after(0, KIND_HALT, 0, (), when)
+
+    def build(self) -> Emits:
+        k = self._k
+        if not self._rows:
+            return Emits.none(k)
+        pad = k - len(self._rows)
+        valid = [jnp.asarray(w, jnp.bool_) for (w, *_r) in self._rows]
+        send = [jnp.asarray(s, jnp.bool_) for (_w, s, *_r) in self._rows]
+        kind = [jnp.asarray(kd, jnp.int32) for (_w, _s, kd, *_r) in self._rows]
+        dst = [jnp.asarray(d, jnp.int32) for (*_h, d, _dl, _a) in self._rows]
+        delay = [jnp.asarray(dl, jnp.int64) for (*_h, dl, _a) in self._rows]
+        args = [
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in a]) for (*_h, a) in self._rows
+        ]
+        z32 = jnp.int32(0)
+        return Emits(
+            valid=jnp.stack(valid + [jnp.asarray(False)] * pad),
+            send=jnp.stack(send + [jnp.asarray(False)] * pad),
+            kind=jnp.stack(kind + [z32] * pad),
+            dst=jnp.stack(dst + [z32] * pad),
+            delay=jnp.stack(delay + [jnp.int64(0)] * pad),
+            args=jnp.stack(args + [jnp.zeros((4,), jnp.int32)] * pad),
+        )
+
+
+@dataclasses.dataclass
+class HandlerCtx:
+    """Everything a handler sees about the event it is processing."""
+
+    now: jnp.ndarray  # int64 ns — virtual clock
+    node: jnp.ndarray  # int32 — the node this event targets
+    state: jnp.ndarray  # (U,) int32 — the node's state row
+    args: jnp.ndarray  # (4,) int32 — event arguments
+    src: jnp.ndarray  # int32 — sender node for messages, -1 for timers
+    draw: Draw  # counter-based RNG for this event
+    max_emits: int
+
+    def emits(self) -> EmitBuilder:
+        return EmitBuilder(self.max_emits)
+
+
+Handler = Callable[[HandlerCtx], tuple]
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """A batched simulation program: per-node int32 state + event handlers.
+
+    This is how "user code" enters the traced step function. Handlers are
+    pure: ``handler(ctx) -> (new_state_row, Emits)``. Handler 0 is
+    ``on_init`` — invoked for every node at t=0 and again when a node is
+    restarted (the stored-init-task semantics of task.rs:279-291).
+    """
+
+    name: str
+    n_nodes: int
+    state_width: int
+    handlers: tuple  # tuple[Handler, ...]
+    max_emits: int = 8
+    init_state: np.ndarray | None = None  # (N,U) int32; zeros if None
+
+    def initial_state(self) -> np.ndarray:
+        if self.init_state is not None:
+            return np.asarray(self.init_state, np.int32)
+        return np.zeros((self.n_nodes, self.state_width), np.int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SimState:
+    """One seed's full simulation state. ``vmap`` adds the (S,) axis."""
+
+    seed: jnp.ndarray  # ()  uint64 instance seed
+    now: jnp.ndarray  # ()  int64 virtual clock, ns
+    step: jnp.ndarray  # ()  uint32 event sequence number (RNG coordinate)
+    halted: jnp.ndarray  # () bool
+    halt_time: jnp.ndarray  # () int64: clock when halted (else 0)
+    trace: jnp.ndarray  # () uint64 rolling hash of dispatched events
+    overflow: jnp.ndarray  # () int32 events dropped to pool overflow
+    msg_count: jnp.ndarray  # () int64 — Stat{msg_count} (network.rs:106-111)
+    # event pool, E slots
+    ev_time: jnp.ndarray  # (E,) int64
+    ev_valid: jnp.ndarray  # (E,) bool
+    ev_kind: jnp.ndarray  # (E,) int32
+    ev_node: jnp.ndarray  # (E,) int32 target node
+    ev_src: jnp.ndarray  # (E,) int32 sender (-1 = timer/engine)
+    ev_epoch: jnp.ndarray  # (E,) int32 target-node epoch at emit time
+    ev_retry: jnp.ndarray  # (E,) int32 clog-backoff retry count
+    ev_args: jnp.ndarray  # (E,4) int32
+    # nodes
+    alive: jnp.ndarray  # (N,) bool
+    epoch: jnp.ndarray  # (N,) int32
+    node_state: jnp.ndarray  # (N,U) int32
+    # network
+    clog: jnp.ndarray  # (N,N) bool — link-clog matrix (net/mod.rs:157-216)
+
+    @property
+    def sim_seconds(self):
+        """Virtual seconds this instance has advanced (bench metric)."""
+        return self.now.astype(jnp.float64) / 1e9
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class _Effects:
+    """Uniform output of every lax.switch branch."""
+
+    node_state: jnp.ndarray  # (U,)
+    emits: Emits
+    kill: jnp.ndarray  # int32 node or -1
+    restart: jnp.ndarray  # int32 node or -1
+    clog_a: jnp.ndarray  # int32
+    clog_b: jnp.ndarray  # int32 (-1 = whole node)
+    clog_set: jnp.ndarray  # int32: -1 none, 0 unclog, 1 clog
+    halt: jnp.ndarray  # bool
+
+
+def _no_effects(state_row: jnp.ndarray, k: int) -> _Effects:
+    m1 = jnp.int32(-1)
+    return _Effects(
+        node_state=state_row,
+        emits=Emits.none(k),
+        kill=m1,
+        restart=m1,
+        clog_a=m1,
+        clog_b=m1,
+        clog_set=m1,
+        halt=jnp.asarray(False),
+    )
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def make_init(wl: Workload, cfg: EngineConfig):
+    """Build ``init(seeds) -> SimState`` (batched over the seeds array).
+
+    Seeds every node with an on_init event at t=0, mirroring the builder
+    running each node's init task at simulation start.
+    """
+    n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
+    if e < n:
+        raise ValueError(f"pool_size={e} must hold at least one event per node ({n})")
+    del k
+    base_state = jnp.asarray(wl.initial_state())
+
+    def init_one(seed) -> SimState:
+        seed = jnp.asarray(seed, jnp.uint64)
+        ev_valid = jnp.zeros((e,), jnp.bool_).at[:n].set(True)
+        ev_kind = jnp.full((e,), KIND_NOP, jnp.int32)
+        ev_kind = ev_kind.at[:n].set(FIRST_USER_KIND)
+        ev_node = jnp.zeros((e,), jnp.int32).at[:n].set(jnp.arange(n, dtype=jnp.int32))
+        return SimState(
+            seed=seed,
+            now=jnp.int64(0),
+            step=jnp.uint32(0),
+            halted=jnp.asarray(False),
+            halt_time=jnp.int64(0),
+            trace=jnp.uint64(0),
+            overflow=jnp.int32(0),
+            msg_count=jnp.int64(0),
+            ev_time=jnp.zeros((e,), jnp.int64),
+            ev_valid=ev_valid,
+            ev_kind=ev_kind,
+            ev_node=ev_node,
+            ev_src=jnp.full((e,), -1, jnp.int32),
+            ev_epoch=jnp.zeros((e,), jnp.int32),
+            ev_retry=jnp.zeros((e,), jnp.int32),
+            ev_args=jnp.zeros((e, 4), jnp.int32),
+            alive=jnp.ones((n,), jnp.bool_),
+            epoch=jnp.zeros((n,), jnp.int32),
+            node_state=base_state,
+            clog=jnp.zeros((n, n), jnp.bool_),
+        )
+
+    def init(seeds) -> SimState:
+        seeds = jnp.asarray(seeds, jnp.uint64)
+        return jax.vmap(init_one)(seeds)
+
+    return init
+
+
+# ---------------------------------------------------------------------------
+# step
+# ---------------------------------------------------------------------------
+
+
+def _trace_fold(trace, now, kind, node, args):
+    """Fold one dispatched event into the rolling trace hash (uint64)."""
+    h = now.astype(jnp.uint64) * _TRACE_MIX
+    h = h ^ (kind.astype(jnp.uint64) << jnp.uint64(32))
+    h = h ^ (node.astype(jnp.uint64) << jnp.uint64(40))
+    a = args.astype(jnp.uint32).astype(jnp.uint64)
+    h = h ^ a[0] ^ (a[1] << jnp.uint64(8)) ^ (a[2] << jnp.uint64(16)) ^ (
+        a[3] << jnp.uint64(24)
+    )
+    return trace * _TRACE_PRIME + h
+
+
+def make_step(wl: Workload, cfg: EngineConfig):
+    """Build the single-seed ``step(SimState) -> SimState`` function.
+
+    Pops the earliest pending event, dispatches it through
+    ``lax.switch`` (engine kinds + user handlers), applies chaos effects,
+    and scatter-inserts emitted events. ``jax.vmap`` over the seed axis
+    and ``lax.scan`` over steps give the batched run loop.
+    """
+    n = wl.n_nodes
+    k = wl.max_emits
+    init_rows = jnp.asarray(wl.initial_state())
+    n_branches = FIRST_USER_KIND + len(wl.handlers)
+
+    # -- switch branches ---------------------------------------------------
+    # lax.switch operands must be pytrees, so the context travels as a
+    # tuple of arrays and each branch rebuilds the HandlerCtx view.
+    def _unpack(op) -> HandlerCtx:
+        now, node, state, args, src, k0, k1, stp = op
+        return HandlerCtx(
+            now=now,
+            node=node,
+            state=state,
+            args=args,
+            src=src,
+            draw=Draw.from_parts(k0, k1, stp),
+            max_emits=k,
+        )
+
+    def _engine_branch(effect_fn):
+        def branch(op):
+            ctx = _unpack(op)
+            eff = _no_effects(ctx.state, k)
+            return effect_fn(eff, ctx)
+
+        return branch
+
+    def _b_kill(eff, ctx):
+        return dataclasses.replace(eff, kill=ctx.args[0])
+
+    def _b_restart(eff, ctx):
+        # the reborn node re-runs its init handler — the stored-init-task
+        # respawn of task.rs:279-291
+        eb = EmitBuilder(k)
+        eb.after(0, FIRST_USER_KIND, ctx.args[0])
+        return dataclasses.replace(eff, restart=ctx.args[0], emits=eb.build())
+
+    def _b_clog(eff, ctx):
+        return dataclasses.replace(
+            eff, clog_a=ctx.args[0], clog_b=ctx.args[1], clog_set=jnp.int32(1)
+        )
+
+    def _b_unclog(eff, ctx):
+        return dataclasses.replace(
+            eff, clog_a=ctx.args[0], clog_b=ctx.args[1], clog_set=jnp.int32(0)
+        )
+
+    def _b_clog_node(eff, ctx):
+        return dataclasses.replace(
+            eff, clog_a=ctx.args[0], clog_b=jnp.int32(-1), clog_set=jnp.int32(1)
+        )
+
+    def _b_unclog_node(eff, ctx):
+        return dataclasses.replace(
+            eff, clog_a=ctx.args[0], clog_b=jnp.int32(-1), clog_set=jnp.int32(0)
+        )
+
+    def _b_halt(eff, ctx):
+        return dataclasses.replace(eff, halt=jnp.asarray(True))
+
+    def _b_nop(eff, ctx):
+        return eff
+
+    def _user_branch(handler):
+        def branch(op):
+            ctx = _unpack(op)
+            new_state, emits = handler(ctx)
+            eff = _no_effects(ctx.state, k)
+            return dataclasses.replace(
+                eff, node_state=jnp.asarray(new_state, jnp.int32), emits=emits
+            )
+
+        return branch
+
+    branches = [
+        _engine_branch(_b_kill),
+        _engine_branch(_b_restart),
+        _engine_branch(_b_clog),
+        _engine_branch(_b_unclog),
+        _engine_branch(_b_clog_node),
+        _engine_branch(_b_unclog_node),
+        _engine_branch(_b_halt),
+        _engine_branch(_b_nop),
+    ] + [_user_branch(h) for h in wl.handlers]
+    assert len(branches) == n_branches
+
+    loss_u32 = cfg.loss_u32
+    time_limit = np.int64(cfg.time_limit_ns) if cfg.time_limit_ns else _INF_NS
+
+    def step(st: SimState) -> SimState:
+        # ---- pop the earliest pending event (the timer-jump of
+        # time/mod.rs:45-60 merged with the ready-queue drain) ----
+        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        i = jnp.argmin(tmask)
+        has_event = st.ev_valid[i]
+        ev_t = jnp.maximum(st.now, st.ev_time[i])
+        over_limit = ev_t > time_limit
+        active = has_event & ~st.halted & ~over_limit
+
+        kind = st.ev_kind[i]
+        dst = st.ev_node[i]
+        src = st.ev_src[i]
+        args = st.ev_args[i]
+        is_engine = kind < FIRST_USER_KIND
+        is_msg = src >= 0
+
+        # liveness/epoch gate: user events to a dead or reincarnated node
+        # are dropped — the kill-drops-futures semantics of task.rs:255-276
+        live = st.alive[dst] & (st.epoch[dst] == st.ev_epoch[i])
+        # clogged links hold messages; re-check with exponential backoff
+        # like the connection pump (net/mod.rs:341-355)
+        clogged = is_msg & st.clog[jnp.maximum(src, 0), dst]
+        dispatch = active & ~clogged & (is_engine | live)
+
+        now = jnp.where(active, ev_t, st.now)
+        draw = Draw(st.seed, st.step)
+        # per-event processing cost, 50-100 ns (task.rs:213)
+        cost = draw.uniform_int(cfg.proc_min_ns, cfg.proc_max_ns, PURPOSE_POLL_COST)
+        now_after = jnp.where(dispatch, now + cost, now)
+
+        # ---- consume / reschedule the popped slot ----
+        retries = st.ev_retry[i]
+        shift = jnp.minimum(retries, jnp.int32(34)).astype(jnp.int64)
+        backoff = jnp.minimum(
+            jnp.int64(cfg.clog_backoff_min_ns) << shift,
+            jnp.int64(cfg.clog_backoff_max_ns),
+        )
+        backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
+        resched = active & clogged
+        ev_valid = st.ev_valid.at[i].set(resched)
+        ev_time = st.ev_time.at[i].set(jnp.where(resched, now + backoff, st.ev_time[i]))
+        ev_retry = st.ev_retry.at[i].set(jnp.where(resched, retries + 1, retries))
+
+        # ---- dispatch ----
+        safe_kind = jnp.clip(kind, 0, n_branches - 1)
+        operand = (now, dst, st.node_state[dst], args, src, draw.k0, draw.k1, draw.step)
+        eff = lax.switch(safe_kind, branches, operand)
+
+        # ---- apply node-state update ----
+        row = jnp.where(dispatch, eff.node_state, st.node_state[dst])
+        node_state = st.node_state.at[dst].set(row)
+
+        # ---- chaos effects: kill / restart / clog ----
+        kill_id = jnp.where(dispatch, eff.kill, jnp.int32(-1))
+        restart_id = jnp.where(dispatch, eff.restart, jnp.int32(-1))
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+        is_killed = node_ids == kill_id
+        is_restarted = node_ids == restart_id
+        alive = jnp.where(is_killed, False, st.alive)
+        alive = jnp.where(is_restarted, True, alive)
+        # epoch bumps invalidate every in-flight event targeting the node
+        epoch = st.epoch + is_killed + is_restarted
+        node_state = jnp.where(is_restarted[:, None], init_rows, node_state)
+
+        clog_set = jnp.where(dispatch, eff.clog_set, jnp.int32(-1))
+        src_ax = node_ids[:, None]
+        dst_ax = node_ids[None, :]
+        # clog_link(a, b) blocks both directions; clog_b < 0 means
+        # clog_node(a): everything in or out of a (net/mod.rs:157-216)
+        pair_sel = ((src_ax == eff.clog_a) & (dst_ax == eff.clog_b)) | (
+            (src_ax == eff.clog_b) & (dst_ax == eff.clog_a)
+        )
+        node_sel = (eff.clog_b < 0) & (
+            (src_ax == eff.clog_a) | (dst_ax == eff.clog_a)
+        )
+        sel = pair_sel | node_sel
+        clog = jnp.where(
+            sel & (clog_set == 1), True, jnp.where(sel & (clog_set == 0), False, st.clog)
+        )
+
+        halted = st.halted | (dispatch & eff.halt) | (has_event & over_limit)
+        halt_time = jnp.where(
+            (halted & ~st.halted), jnp.minimum(now, time_limit), st.halt_time
+        )
+
+        # ---- translate emits into pool insertions ----
+        em = eff.emits
+        slot_ix = jnp.arange(k, dtype=jnp.uint32)
+        lat_bits = jax.vmap(lambda s: draw.bits(jnp.uint32(PURPOSE_LATENCY) + s))(
+            slot_ix
+        )
+        loss_bits = jax.vmap(lambda s: draw.bits(jnp.uint32(PURPOSE_LOSS) + s))(slot_ix)
+        span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
+        latency = jnp.int64(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int64)
+        lost = em.send & (loss_bits < jnp.uint32(loss_u32))
+
+        e_valid = dispatch & em.valid & ~lost
+        # sends to dead nodes are dropped at send time (socket gone,
+        # network.rs:311-313); timers to dead nodes die via the epoch gate
+        e_valid = e_valid & jnp.where(em.send, alive[em.dst], True)
+        e_time = now_after + jnp.where(em.send, latency, em.delay)
+        e_src = jnp.where(em.send, dst, jnp.int32(-1))
+        e_epoch = epoch[em.dst]
+        # engine-kind events bypass the epoch gate; keep their slot epoch 0
+        e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
+
+        free = jnp.flatnonzero(~ev_valid, size=k, fill_value=ev_valid.shape[0])
+        # compact: the j-th *valid* emit takes the j-th free slot, so
+        # sparse emit patterns (gated `when` rows) don't waste slots and
+        # only a genuinely full pool drops events
+        pos = jnp.cumsum(e_valid.astype(jnp.int32)) - 1
+        slot = jnp.where(
+            e_valid,
+            free[jnp.clip(pos, 0, k - 1)],
+            jnp.int32(ev_valid.shape[0]),
+        )
+        dropped = e_valid & (slot >= ev_valid.shape[0])
+        overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
+        msg_count = st.msg_count + jnp.sum(
+            dispatch & em.valid & em.send
+        ).astype(jnp.int64)
+
+        ev_valid = ev_valid.at[free].set(e_valid, mode="drop")
+        ev_time = ev_time.at[free].set(e_time, mode="drop")
+        ev_kind = st.ev_kind.at[free].set(em.kind, mode="drop")
+        ev_node = st.ev_node.at[free].set(em.dst, mode="drop")
+        ev_src = st.ev_src.at[free].set(e_src, mode="drop")
+        ev_epoch = st.ev_epoch.at[free].set(e_epoch, mode="drop")
+        ev_retry = ev_retry.at[free].set(jnp.zeros((k,), jnp.int32), mode="drop")
+        ev_args = st.ev_args.at[free].set(em.args, mode="drop")
+
+        # ---- trace + clock ----
+        trace = jnp.where(
+            dispatch, _trace_fold(st.trace, now, kind, dst, args), st.trace
+        )
+        return SimState(
+            seed=st.seed,
+            now=now_after,
+            step=st.step + jnp.uint32(1),
+            halted=halted,
+            halt_time=halt_time,
+            trace=trace,
+            overflow=overflow,
+            msg_count=msg_count,
+            ev_time=ev_time,
+            ev_valid=ev_valid,
+            ev_kind=ev_kind,
+            ev_node=ev_node,
+            ev_src=ev_src,
+            ev_epoch=ev_epoch,
+            ev_retry=ev_retry,
+            ev_args=ev_args,
+            alive=alive,
+            epoch=epoch,
+            node_state=node_state,
+            clog=clog,
+        )
+
+    return step
+
+
+def make_run(wl: Workload, cfg: EngineConfig, n_steps: int):
+    """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
+
+    The returned function is jit-friendly and sharding-friendly: every
+    array's leading axis is the seed axis, so a NamedSharding over that
+    axis turns this into pure data-parallel work across chips with zero
+    collectives in the hot loop (results are combined host-side).
+    """
+    step = jax.vmap(make_step(wl, cfg))
+
+    def run(state: SimState) -> SimState:
+        def body(s, _):
+            return step(s), None
+
+        final, _ = lax.scan(body, state, None, length=n_steps)
+        return final
+
+    return run

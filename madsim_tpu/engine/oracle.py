@@ -1,0 +1,149 @@
+"""ctypes bridge to the C++ single-seed oracle (native/oracle.cpp).
+
+The oracle independently reimplements the engine's integer semantics and
+the benchmark workloads; :func:`run_oracle` runs one seed and returns the
+fields the bit-identical trace compare checks (the batched-engine analog
+of the reference's replay determinism checker, runtime/mod.rs:165-190).
+
+The shared library is built on demand with ``make -C native`` (g++ is in
+the image; pybind11 is not, hence the plain C ABI + ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from dataclasses import dataclass
+
+import numpy as np
+
+from .core import EngineConfig, Workload
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE = os.path.join(_REPO, "native")
+_LIB = os.path.join(_NATIVE, "lib", "liboracle.so")
+
+WORKLOAD_IDS = {"pingpong": 0, "microbench": 1, "raft-election": 2}
+
+_lib = None
+
+
+def build() -> str:
+    """Build (if stale) and return the shared library path."""
+    src = os.path.join(_NATIVE, "oracle.cpp")
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(src):
+        subprocess.run(["make", "-C", _NATIVE], check=True, capture_output=True)
+    return _LIB
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(build())
+        lib.oracle_run.restype = ctypes.c_int32
+        lib.oracle_run.argtypes = [
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int64,  # wl, seed, steps
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # pool, lat lo/hi
+            ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,  # loss, proc lo/hi
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # backoff lo/hi, limit
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.oracle_threefry2x32.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ]
+        _lib = lib
+    return _lib
+
+
+@dataclass
+class OracleResult:
+    now: int
+    trace: int
+    msg_count: int
+    halted: bool
+    halt_time: int
+    overflow: int
+    node_state: np.ndarray  # (N, U) int32
+
+
+def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
+    """Push model factory parameters into the oracle's compiled workload."""
+    if wl.name == "pingpong":
+        lib.oracle_set_pingpong(
+            ctypes.c_int32(model_kwargs["rounds"]),
+            ctypes.c_int32(model_kwargs.get("n_clients", 2)),
+        )
+    elif wl.name == "microbench":
+        lib.oracle_set_microbench(
+            ctypes.c_int32(model_kwargs["rounds"]),
+            ctypes.c_int64(model_kwargs.get("delay_min_ns", 1_000)),
+            ctypes.c_int64(model_kwargs.get("delay_max_ns", 1_000_000)),
+        )
+    elif wl.name == "raft-election":
+        lib.oracle_set_raft(
+            ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
+            ctypes.c_int64(model_kwargs.get("timeout_min_ns", 150_000_000)),
+            ctypes.c_int64(model_kwargs.get("timeout_max_ns", 300_000_000)),
+        )
+    else:
+        raise ValueError(f"oracle has no implementation of workload {wl.name!r}")
+
+
+def run_oracle(
+    wl: Workload, cfg: EngineConfig, seed: int, n_steps: int, **model_kwargs
+) -> OracleResult:
+    """Run one seed through the C++ oracle."""
+    lib = load()
+    set_params(lib, wl, **model_kwargs)
+    now = ctypes.c_int64()
+    trace = ctypes.c_uint64()
+    msg_count = ctypes.c_int64()
+    halted = ctypes.c_int32()
+    halt_time = ctypes.c_int64()
+    overflow = ctypes.c_int32()
+    node_state = np.zeros((wl.n_nodes, wl.state_width), np.int32)
+    rc = lib.oracle_run(
+        ctypes.c_int32(WORKLOAD_IDS[wl.name]),
+        ctypes.c_uint64(seed),
+        ctypes.c_int64(n_steps),
+        ctypes.c_int64(cfg.pool_size),
+        ctypes.c_int64(cfg.lat_min_ns),
+        ctypes.c_int64(cfg.lat_max_ns),
+        ctypes.c_uint32(cfg.loss_u32),
+        ctypes.c_int64(cfg.proc_min_ns),
+        ctypes.c_int64(cfg.proc_max_ns),
+        ctypes.c_int64(cfg.clog_backoff_min_ns),
+        ctypes.c_int64(cfg.clog_backoff_max_ns),
+        ctypes.c_int64(cfg.time_limit_ns),
+        ctypes.byref(now),
+        ctypes.byref(trace),
+        ctypes.byref(msg_count),
+        ctypes.byref(halted),
+        ctypes.byref(halt_time),
+        ctypes.byref(overflow),
+        node_state.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"oracle_run failed with rc={rc}")
+    return OracleResult(
+        now=now.value,
+        trace=trace.value,
+        msg_count=msg_count.value,
+        halted=bool(halted.value),
+        halt_time=halt_time.value,
+        overflow=overflow.value,
+        node_state=node_state,
+    )
+
+
+def oracle_threefry(k0: int, k1: int, x0: int, x1: int) -> tuple[int, int]:
+    lib = load()
+    o0 = ctypes.c_uint32()
+    o1 = ctypes.c_uint32()
+    lib.oracle_threefry2x32(k0, k1, x0, x1, ctypes.byref(o0), ctypes.byref(o1))
+    return o0.value, o1.value

@@ -1,0 +1,182 @@
+"""Counter-based RNG for the batched engine.
+
+The reference simulator draws every random decision from one serial
+``SmallRng`` stream (reference madsim/src/sim/rand.rs:30-61): draw N
+depends on draws 1..N-1 having happened, which serializes the whole
+simulation. That is exactly what does not map to a TPU. The batched
+engine replaces the serial stream with a **counter-based** generator:
+every draw is a pure function of ``(instance_seed, event_step, purpose)``,
+so draws are order-independent, trivially vectorizable over the seed
+axis, and reproducible from coordinates alone — the property the
+determinism checker and the C++ oracle rely on.
+
+The block cipher is an explicit Threefry-2x32-20 implementation (the
+Random123 construction, same family JAX uses internally) written here in
+plain uint32 ops so that:
+  * the spec is owned by this file — the numpy mirror
+    (:func:`np_threefry2x32`) and the C++ oracle implement the identical
+    function, giving bit-exact cross-backend traces;
+  * it runs inside ``vmap``/``jit`` with no host callbacks;
+  * TPU executes it as pure 32-bit integer ALU work (no MXU needed, and
+    no reliance on JAX PRNG implementation details that could change).
+
+Draw discipline (mirrored by engine/core.py and the oracle):
+  key     = (seed & 0xffffffff, seed >> 32)          # per-instance
+  counter = (event_step, purpose)                     # per-draw
+  value   = threefry2x32(key, counter)[0]             # 32 uniform bits
+
+``purpose`` namespaces the draws made while processing one event: engine
+purposes live in [0, 128) (poll cost, per-emit latency/loss, clog
+backoff), user handler purposes in [128, 2^32).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "threefry2x32",
+    "np_threefry2x32",
+    "Draw",
+    "PURPOSE_POLL_COST",
+    "PURPOSE_LATENCY",
+    "PURPOSE_LOSS",
+    "PURPOSE_USER",
+]
+
+# Threefry-2x32 rotation schedule (Random123 / Salmon et al. 2011).
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+# Skein key-schedule parity constant for 32-bit words.
+_PARITY = np.uint32(0x1BD11BDA)
+
+# Engine purpose namespace. One event-step makes at most one draw per
+# purpose, so (seed, step, purpose) uniquely keys every draw in a run.
+PURPOSE_POLL_COST = 0  # 50-100 ns per-event processing cost
+PURPOSE_CLOG_JITTER = 1  # clogged-link recheck jitter
+PURPOSE_LATENCY = 8  # + emit slot  (8 .. 8+K)
+PURPOSE_LOSS = 64  # + emit slot  (64 .. 64+K)
+PURPOSE_USER = 128  # + user purpose
+
+
+def _rotl32(x, r: int):
+    """Rotate a uint32 left by the static amount ``r``."""
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def threefry2x32(k0, k1, x0, x1):
+    """Threefry-2x32, 20 rounds. All inputs/outputs are uint32 arrays.
+
+    Pure jnp integer ops: identical bit patterns on CPU and TPU backends,
+    which is what makes batched-vs-oracle traces exactly comparable.
+    """
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    x0 = jnp.asarray(x0, jnp.uint32)
+    x1 = jnp.asarray(x1, jnp.uint32)
+    ks = (k0, k1, k0 ^ k1 ^ jnp.uint32(_PARITY))
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+    for chunk in range(5):
+        rots = _ROTATIONS[:4] if chunk % 2 == 0 else _ROTATIONS[4:]
+        for r in rots:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r)
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(chunk + 1) % 3]
+        x1 = x1 + ks[(chunk + 2) % 3] + jnp.uint32(chunk + 1)
+    return x0, x1
+
+
+def np_threefry2x32(k0, k1, x0, x1):
+    """Numpy mirror of :func:`threefry2x32` — the oracle's generator.
+
+    Kept textually parallel to the jnp version on purpose; any divergence
+    is a bug the trace-compare tests will catch.
+    """
+    k0 = np.uint32(k0)
+    k1 = np.uint32(k1)
+    x0 = np.uint32(x0)
+    x1 = np.uint32(x1)
+    with np.errstate(over="ignore"):
+        ks = (k0, k1, np.uint32(k0 ^ k1 ^ _PARITY))
+        x0 = np.uint32(x0 + ks[0])
+        x1 = np.uint32(x1 + ks[1])
+        for chunk in range(5):
+            rots = _ROTATIONS[:4] if chunk % 2 == 0 else _ROTATIONS[4:]
+            for r in rots:
+                x0 = np.uint32(x0 + x1)
+                x1 = np.uint32((x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r)))
+                x1 = np.uint32(x1 ^ x0)
+            x0 = np.uint32(x0 + ks[(chunk + 1) % 3])
+            x1 = np.uint32(x1 + ks[(chunk + 2) % 3] + np.uint32(chunk + 1))
+    return x0, x1
+
+
+class Draw:
+    """Per-event draw context handed to handlers (and used by the engine).
+
+    Wraps the ``(seed, step)`` coordinates; each method makes one draw
+    under a caller-chosen purpose. All methods are jnp-traceable scalars
+    and therefore vmap cleanly over the seed axis.
+    """
+
+    __slots__ = ("k0", "k1", "step")
+
+    def __init__(self, seed_u64, step_u32):
+        seed = jnp.asarray(seed_u64, jnp.uint64)
+        self.k0 = (seed & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        self.k1 = (seed >> jnp.uint64(32)).astype(jnp.uint32)
+        self.step = jnp.asarray(step_u32, jnp.uint32)
+
+    @classmethod
+    def from_parts(cls, k0, k1, step) -> "Draw":
+        d = cls.__new__(cls)
+        d.k0 = jnp.asarray(k0, jnp.uint32)
+        d.k1 = jnp.asarray(k1, jnp.uint32)
+        d.step = jnp.asarray(step, jnp.uint32)
+        return d
+
+    def bits(self, purpose) -> jnp.ndarray:
+        """32 uniform bits for ``purpose`` (uint32)."""
+        a, _ = threefry2x32(self.k0, self.k1, self.step, jnp.uint32(purpose))
+        return a
+
+    def uniform_int(self, lo, hi, purpose):
+        """Uniform int64 in [lo, hi).
+
+        Uses modulo reduction — a ≤2^-32 bias, identical in the oracle,
+        matching the determinism contract (exactness over de-biasing).
+        """
+        span = (jnp.asarray(hi, jnp.int64) - jnp.asarray(lo, jnp.int64)).astype(
+            jnp.uint32
+        )
+        v = self.bits(purpose) % jnp.maximum(span, jnp.uint32(1))
+        return jnp.asarray(lo, jnp.int64) + v.astype(jnp.int64)
+
+    def chance(self, threshold_u32, purpose):
+        """True with probability threshold/2^32 — integer-exact Bernoulli.
+
+        ``threshold_u32 = int(p * 2**32)`` is computed statically in
+        Python so the comparison itself is pure uint32 — no float
+        rounding can diverge between backends.
+        """
+        return self.bits(purpose) < jnp.uint32(threshold_u32)
+
+    def user(self, purpose):
+        """32 bits in the user purpose namespace (handlers call this)."""
+        return self.bits(jnp.uint32(PURPOSE_USER) + jnp.uint32(purpose))
+
+    def user_int(self, lo, hi, purpose):
+        """Uniform int64 in [lo, hi) in the user purpose namespace."""
+        return self.uniform_int(lo, hi, PURPOSE_USER + purpose)
+
+
+def chance_threshold(p: float) -> int:
+    """Static helper: probability -> uint32 threshold for :meth:`Draw.chance`."""
+    if p <= 0.0:
+        return 0
+    if p >= 1.0:
+        return (1 << 32) - 1
+    return int(p * (1 << 32))

@@ -1,0 +1,17 @@
+"""Batched simulation workloads ("models") for the engine.
+
+Each module builds a :class:`madsim_tpu.engine.Workload`: per-node int32
+state plus pure event handlers, the state-machine form in which user
+programs enter the XLA-compiled step function. These four cover the
+benchmark configs in BASELINE.md:
+
+  1. pingpong    — 3-node ping-pong RPC (tonic-example shape)
+  2. microbench  — single-node timer+rand loop (no network)
+  3. broadcast   — 5-node broadcast under latency/loss/partition chaos
+  4. raft        — 5-node leader election (the north-star workload)
+"""
+
+from .microbench import make_microbench  # noqa: F401
+from .pingpong import make_pingpong  # noqa: F401
+from .broadcast import make_broadcast  # noqa: F401
+from .raft import make_raft  # noqa: F401

@@ -1,0 +1,55 @@
+"""Single-node timer + RNG microbenchmark (BASELINE.md config 2).
+
+The pure time/rand core with no network: a node repeatedly sleeps a
+random interval and folds a random draw into an accumulator — the
+batched analog of a madsim test that only uses ``time::sleep`` and
+``rand`` (reference sim/time/mod.rs + sim/rand.rs). Measures raw engine
+event throughput.
+
+State row: [tick_count, accumulator, 0, 0]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import Workload, user_kind
+
+_H_INIT = 0
+_H_TICK = 1
+
+# user draw purposes
+_P_DELAY = 0
+_P_VALUE = 1
+
+
+def make_microbench(
+    rounds: int = 1000,
+    delay_min_ns: int = 1_000,
+    delay_max_ns: int = 1_000_000,
+) -> Workload:
+    def on_init(ctx):
+        eb = ctx.emits()
+        d = ctx.draw.user_int(delay_min_ns, delay_max_ns, _P_DELAY)
+        eb.after(d, user_kind(_H_TICK), ctx.node)
+        return ctx.state, eb.build()
+
+    def on_tick(ctx):
+        st = ctx.state
+        count = st[0] + jnp.int32(1)
+        bits = ctx.draw.user(_P_VALUE).astype(jnp.int32)
+        new = st.at[0].set(count).at[1].set(st[1] ^ bits)
+        done = count >= jnp.int32(rounds)
+        eb = ctx.emits()
+        d = ctx.draw.user_int(delay_min_ns, delay_max_ns, _P_DELAY)
+        eb.after(d, user_kind(_H_TICK), ctx.node, when=~done)
+        eb.halt(when=done)
+        return new, eb.build()
+
+    return Workload(
+        name="microbench",
+        n_nodes=1,
+        state_width=4,
+        handlers=(on_init, on_tick),
+        max_emits=2,
+    )

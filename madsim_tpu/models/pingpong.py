@@ -1,0 +1,65 @@
+"""3-node ping-pong RPC (BASELINE.md config 1 — the tonic-example shape).
+
+One server (node 0) and two clients (nodes 1, 2): each client sends
+``rounds`` pings, the server answers each with a pong carrying the same
+sequence number (the unary-RPC pattern of the reference's
+tonic-example/src/server.rs), and the run halts when both clients have
+finished. Exercises the full send -> latency -> deliver -> reply path.
+
+Server state: [completed_clients, pings_served, 0, 0]
+Client state: [next_seq, 0, 0, 0]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import Workload, user_kind
+
+_H_INIT = 0
+_H_PING = 1  # at server: args = (seq, client)
+_H_PONG = 2  # at client: args = (seq,)
+_H_DONE = 3  # at server: client finished
+
+SERVER = 0
+
+
+def make_pingpong(rounds: int = 10, n_clients: int = 2) -> Workload:
+    n = 1 + n_clients
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        is_client = ctx.node != jnp.int32(SERVER)
+        eb.send(SERVER, user_kind(_H_PING), (jnp.int32(0), ctx.node), when=is_client)
+        return ctx.state, eb.build()
+
+    def on_ping(ctx):
+        seq, client = ctx.args[0], ctx.args[1]
+        new = ctx.state.at[1].set(ctx.state[1] + 1)
+        eb = ctx.emits()
+        eb.send(client, user_kind(_H_PONG), (seq,))
+        return new, eb.build()
+
+    def on_pong(ctx):
+        seq = ctx.args[0] + jnp.int32(1)
+        new = ctx.state.at[0].set(seq)
+        done = seq >= jnp.int32(rounds)
+        eb = ctx.emits()
+        eb.send(SERVER, user_kind(_H_PING), (seq, ctx.node), when=~done)
+        eb.send(SERVER, user_kind(_H_DONE), (), when=done)
+        return new, eb.build()
+
+    def on_done(ctx):
+        finished = ctx.state[0] + jnp.int32(1)
+        new = ctx.state.at[0].set(finished)
+        eb = ctx.emits()
+        eb.halt(when=finished >= jnp.int32(n_clients))
+        return new, eb.build()
+
+    return Workload(
+        name="pingpong",
+        n_nodes=n,
+        state_width=4,
+        handlers=(on_init, on_ping, on_pong, on_done),
+        max_emits=2,
+    )

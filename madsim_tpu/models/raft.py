@@ -1,0 +1,142 @@
+"""Raft-style 5-node leader election — the north-star workload
+(BASELINE.md config 4: 65,536 seeds, >= 200k simulated-seconds/sec).
+
+The MadRaft-shaped scenario the reference ecosystem uses for DST: five
+nodes with randomized election timeouts (150-300 ms) race to win a
+majority under 1-10 ms message latency, packet loss, and (optionally) a
+leader kill + restart. The seed decides every timeout and latency draw,
+so each seed explores a different interleaving; the instance halts when
+a leader first wins an election (halt_time = election latency).
+
+State row: [role, term, voted_term, votes, timeout_seq, 0]
+  role: 0 follower, 1 candidate, 2 leader
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import Workload, user_kind
+
+_H_INIT = 0
+_H_TIMEOUT = 1  # args = (timeout_seq,)
+_H_REQVOTE = 2  # args = (term, candidate)
+_H_GRANT = 3  # args = (term,)
+_H_HEARTBEAT = 4  # args = (term,)
+
+ROLE, TERM, VOTED, VOTES, TSEQ = 0, 1, 2, 3, 4
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+_P_TIMEOUT = 0
+
+
+def make_raft(
+    n_nodes: int = 5,
+    timeout_min_ns: int = 150_000_000,
+    timeout_max_ns: int = 300_000_000,
+) -> Workload:
+    majority = n_nodes // 2 + 1
+    nodes = list(range(n_nodes))
+
+    def _arm_timer(ctx, eb, new_seq, when):
+        d = ctx.draw.user_int(timeout_min_ns, timeout_max_ns, _P_TIMEOUT)
+        eb.after(d, user_kind(_H_TIMEOUT), ctx.node, (new_seq,), when=when)
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        _arm_timer(ctx, eb, jnp.int32(1), True)
+        new = ctx.state.at[TSEQ].set(1)
+        return new, eb.build()
+
+    def on_timeout(ctx):
+        st = ctx.state
+        fire = (ctx.args[0] == st[TSEQ]) & (st[ROLE] != jnp.int32(LEADER))
+        term = st[TERM] + 1
+        new = jnp.where(
+            fire,
+            st.at[ROLE]
+            .set(CANDIDATE)
+            .at[TERM]
+            .set(term)
+            .at[VOTED]
+            .set(term)
+            .at[VOTES]
+            .set(1)
+            .at[TSEQ]
+            .set(st[TSEQ] + 1),
+            st,
+        )
+        eb = ctx.emits()
+        for p in nodes:
+            eb.send(
+                p,
+                user_kind(_H_REQVOTE),
+                (term, ctx.node),
+                when=fire & (jnp.int32(p) != ctx.node),
+            )
+        _arm_timer(ctx, eb, st[TSEQ] + 1, fire)
+        return new, eb.build()
+
+    def on_reqvote(ctx):
+        st = ctx.state
+        term, cand = ctx.args[0], ctx.args[1]
+        # step down on a newer term
+        newer = term > st[TERM]
+        st1 = jnp.where(
+            newer,
+            st.at[TERM].set(term).at[ROLE].set(FOLLOWER).at[VOTES].set(0),
+            st,
+        )
+        grant = (term == st1[TERM]) & (st1[VOTED] < term)
+        new = jnp.where(grant, st1.at[VOTED].set(term).at[TSEQ].set(st1[TSEQ] + 1), st1)
+        eb = ctx.emits()
+        eb.send(cand, user_kind(_H_GRANT), (term,), when=grant)
+        # granting resets the election timer (vote then wait)
+        _arm_timer(ctx, eb, st1[TSEQ] + 1, grant)
+        return new, eb.build()
+
+    def on_grant(ctx):
+        st = ctx.state
+        term = ctx.args[0]
+        counts = (st[ROLE] == jnp.int32(CANDIDATE)) & (term == st[TERM])
+        votes = jnp.where(counts, st[VOTES] + 1, st[VOTES])
+        wins = counts & (votes >= jnp.int32(majority))
+        new = st.at[VOTES].set(votes)
+        new = jnp.where(wins, new.at[ROLE].set(LEADER), new)
+        eb = ctx.emits()
+        for p in nodes:
+            eb.send(
+                p,
+                user_kind(_H_HEARTBEAT),
+                (term,),
+                when=wins & (jnp.int32(p) != ctx.node),
+            )
+        # leader elected: scenario complete (halt_time = election latency)
+        eb.halt(when=wins)
+        return new, eb.build()
+
+    def on_heartbeat(ctx):
+        st = ctx.state
+        term = ctx.args[0]
+        accept = term >= st[TERM]
+        new = jnp.where(
+            accept,
+            st.at[TERM]
+            .set(term)
+            .at[ROLE]
+            .set(FOLLOWER)
+            .at[TSEQ]
+            .set(st[TSEQ] + 1),
+            st,
+        )
+        eb = ctx.emits()
+        _arm_timer(ctx, eb, st[TSEQ] + 1, accept)
+        return new, eb.build()
+
+    return Workload(
+        name="raft-election",
+        n_nodes=n_nodes,
+        state_width=6,
+        handlers=(on_init, on_timeout, on_reqvote, on_grant, on_heartbeat),
+        max_emits=n_nodes + 1,
+    )

@@ -133,6 +133,13 @@ class Endpoint:
     def local_addr(self) -> SocketAddr:
         return self._addr
 
+    def close(self) -> None:
+        """Unbind from the network, releasing the socket-table entry
+        (Network::close, network.rs:261). Ephemeral per-connection
+        endpoints (e.g. TcpStream.connect) must call this or the node's
+        port space leaks one entry per connect."""
+        self._net.network.close(self._node, self._addr, self._proto)
+
     def _visible_src(self, dst_ip: str) -> SocketAddr:
         """Source address as seen by the receiver: loopback for local
         destinations, the node IP otherwise. A node without an assigned IP

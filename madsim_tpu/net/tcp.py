@@ -34,6 +34,7 @@ class TcpStream:
         rx: PipeReceiver,
         local_addr: SocketAddr,
         peer_addr: SocketAddr,
+        owned_ep: Optional[Endpoint] = None,
     ):
         self._tx = tx
         self._rx = rx
@@ -42,14 +43,21 @@ class TcpStream:
         self._wbuf = bytearray()
         self._rbuf = bytearray()
         self._eof = False
+        # the ephemeral endpoint backing an outbound connection — unbound
+        # on close so connection churn doesn't exhaust the port space
+        self._owned_ep = owned_ep
 
     # ---- construction ---------------------------------------------------
     @classmethod
     async def connect(cls, addr: AddrLike) -> "TcpStream":
         """Connect from the current node (stream.rs:71-91)."""
         ep = await Endpoint.bind(("0.0.0.0", 0), _proto=Protocols.TCP)
-        tx, rx = await ep.connect1(addr)
-        return cls(tx, rx, ep.local_addr, parse_addr(addr))
+        try:
+            tx, rx = await ep.connect1(addr)
+        except BaseException:
+            ep.close()
+            raise
+        return cls(tx, rx, ep.local_addr, parse_addr(addr), owned_ep=ep)
 
     @property
     def local_addr(self) -> SocketAddr:
@@ -112,6 +120,9 @@ class TcpStream:
     def close(self) -> None:
         """Close the whole stream, releasing both directions' resources."""
         self._tx.close()
+        if self._owned_ep is not None:
+            self._owned_ep.close()
+            self._owned_ep = None
 
 
 class TcpListener:

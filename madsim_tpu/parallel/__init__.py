@@ -1,0 +1,69 @@
+"""Seed-axis sharding over TPU device meshes.
+
+The reference scales by running `MADSIM_TEST_NUM` seeds across
+`MADSIM_TEST_JOBS` OS threads, one runtime per thread (reference
+madsim/src/sim/runtime/builder.rs:110-148). The TPU-native scaling axis
+is the same logical thing mapped to hardware: the seed batch is sharded
+over a `jax.sharding.Mesh`, every chip advances its shard of seeds in
+lockstep, and XLA inserts zero collectives in the hot loop because the
+work is embarrassingly parallel along the seed axis — ICI/DCN are only
+touched when results are gathered.
+
+A 2D ('host', 'chip') mesh mirrors the DCN x ICI hierarchy: the seed
+axis is sharded over both, so placement composes with multi-host
+deployments the way data parallelism does in the scaling playbook.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "seed_sharding", "shard_state", "shard_over_seeds"]
+
+
+def make_mesh(devices=None, hosts: int | None = None) -> Mesh:
+    """Build a ('host', 'chip') mesh over the given (default: all) devices.
+
+    ``hosts`` defaults to the actual process/host count when running
+    multi-host, else 1; the remaining factor becomes the chip axis.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if hosts is None:
+        hosts = getattr(jax, "process_count", lambda: 1)()
+        if n % hosts != 0:
+            hosts = 1
+    grid = np.asarray(devices).reshape(hosts, n // hosts)
+    return Mesh(grid, axis_names=("host", "chip"))
+
+
+def seed_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits the leading (seed) axis across every mesh axis."""
+    return NamedSharding(mesh, P(mesh.axis_names))
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a batched SimState so its seed axis is split across the mesh.
+
+    Every leaf of the state pytree has seeds leading, so one sharding
+    applies uniformly.
+    """
+    sh = seed_sharding(mesh)
+    return jax.device_put(state, sh)
+
+
+def shard_over_seeds(fn, mesh: Mesh):
+    """Compile ``fn(state) -> state`` with the seed axis sharded over ``mesh``.
+
+    GSPMD partitions the whole scan along the seed axis; each device runs
+    its shard of independent simulations with no cross-device
+    communication inside the loop.
+    """
+    sh = seed_sharding(mesh)
+    # a single sharding is a valid pytree prefix: it broadcasts to every
+    # leaf of the SimState, all of which lead with the seed axis
+    return jax.jit(fn, in_shardings=sh, out_shardings=sh)

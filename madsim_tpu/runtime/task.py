@@ -291,7 +291,9 @@ class Executor:
             # delay (task.rs:187-206, runtime/mod.rs:319-325).
             delay_ns = self.rng.randrange(1_000_000_000, 10_000_000_000)
             node_id = node.id
-            task._fut.set_exception(JoinError(f"task {task.name!r} panicked: {exc!r}"))
+            je = JoinError(f"task {task.name!r} panicked: {exc!r}")
+            je.__cause__ = exc
+            task._fut.set_exception(je)
             self.kill_node(node_id)
             self.time.add_timer_at(
                 self.time.now_ns() + delay_ns,
@@ -303,7 +305,9 @@ class Executor:
         # handle expected errors, return them as values from the task.)
         # This is deliberately independent of whether anyone is awaiting the
         # JoinHandle — error routing must not depend on scheduling order.
-        task._fut.set_exception(JoinError(f"task {task.name!r} panicked"))
+        je = JoinError(f"task {task.name!r} panicked")
+        je.__cause__ = exc
+        task._fut.set_exception(je)
         self._pending_panic = exc
 
     # ---- node lifecycle (task.rs:255-332) -------------------------------

@@ -1,0 +1,89 @@
+"""Shared one-connection-per-request transport for service simulators.
+
+The reference's etcd and kafka shims both use the same pattern — each
+client op opens a connection, sends one request, reads one reply
+(madsim-etcd-client/src/kv.rs:25-100, madsim-rdkafka's sim clients) and
+the server answers each accepted connection once. This module is that
+pattern factored out so connection hygiene (half-close on the server so
+the reply drains; full close on the client after reading) lives in one
+place for every service built on it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Awaitable, Callable, Type
+
+from ..net.addr import AddrLike
+from ..net.endpoint import Endpoint
+from ..runtime.task import spawn
+
+__all__ = ["RequestClient", "serve_requests"]
+
+
+class RequestClient:
+    """Client core: ``await call(op, **kwargs)`` = one round-trip.
+
+    ``transport_error(str) -> Exception`` wraps connection failures in
+    the service's own error type.
+    """
+
+    def __init__(self, ep: Endpoint, dst, transport_error: Callable[[str], Exception]):
+        self._ep = ep
+        self._dst = dst
+        self._err = transport_error
+
+    async def call(self, op: str, **kwargs: Any) -> Any:
+        try:
+            tx, rx = await self._ep.connect1(self._dst)
+        except (ConnectionError, OSError) as e:
+            raise self._err(str(e)) from e
+        try:
+            await tx.send((op, kwargs))
+            reply = await rx.recv()
+        except (ConnectionError, OSError) as e:
+            raise self._err(str(e)) from e
+        finally:
+            # one request per connection: release pipes + pump tasks
+            tx.close()
+        if reply is None:
+            raise self._err("connection reset")
+        status, payload = reply
+        if status == "err":
+            raise payload
+        return payload
+
+
+async def serve_requests(
+    addr: AddrLike,
+    handler: Callable[[str, dict], Awaitable[Any]],
+    error_type: Type[Exception],
+    name: str = "service-request",
+) -> None:
+    """Server accept loop: each connection carries one (op, kwargs)
+    request; the handler's return value (or raised ``error_type``) is
+    the reply. Replies are half-closed so they drain through the pump
+    before the peer sees EOF."""
+    ep = await Endpoint.bind(addr)
+    while True:
+        tx, rx, _peer = await ep.accept1()
+        spawn(_serve_one(tx, rx, handler, error_type), name=name)
+
+
+async def _serve_one(tx, rx, handler, error_type) -> None:
+    try:
+        req = await rx.recv()
+        if req is None:
+            return
+        op, kwargs = req
+        try:
+            result = await handler(op, kwargs)
+            await tx.send(("ok", result))
+        except error_type as e:
+            try:
+                await tx.send(("err", e))
+            except ConnectionError:
+                pass
+        except ConnectionError:
+            pass
+    finally:
+        tx.shutdown()

@@ -1,0 +1,599 @@
+// Single-seed C++ oracle for the batched JAX engine.
+//
+// The reference's determinism checker replays a run and compares the RNG
+// op stream (reference madsim/src/sim/runtime/mod.rs:165-190,
+// rand.rs:64-110). The batched engine's analog is stronger: this file is
+// an *independent reimplementation* of the engine's integer semantics
+// (engine/core.py) and its counter-based RNG (engine/rng.py), plus the
+// benchmark workloads (models/*.py), in plain C++ — no JAX, no arrays.
+// For any (workload, seed, config) the oracle's rolling trace hash must
+// equal the engine's bit-for-bit; tests/test_oracle.py enforces it.
+// A divergence means one side misimplements the spec.
+//
+// Built as a shared library (native/Makefile) and loaded via ctypes
+// (engine/oracle.py) — the environment has no pybind11, and a C ABI is
+// all this needs.
+//
+// Everything here is integer arithmetic: uint32 threefry, int64
+// nanosecond clocks, uint64 trace hashes. Keep textually close to the
+// Python spec; cite the mirrored definition in comments.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// ---- threefry2x32 (engine/rng.py threefry2x32) --------------------------
+constexpr uint32_t kParity = 0x1BD11BDA;
+constexpr int kRot[8] = {13, 15, 26, 6, 17, 29, 16, 24};
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+void threefry2x32(uint32_t k0, uint32_t k1, uint32_t x0, uint32_t x1,
+                  uint32_t* o0, uint32_t* o1) {
+  uint32_t ks[3] = {k0, k1, static_cast<uint32_t>(k0 ^ k1 ^ kParity)};
+  x0 += ks[0];
+  x1 += ks[1];
+  for (int chunk = 0; chunk < 5; chunk++) {
+    const int* rots = (chunk % 2 == 0) ? kRot : kRot + 4;
+    for (int j = 0; j < 4; j++) {
+      x0 += x1;
+      x1 = rotl32(x1, rots[j]);
+      x1 ^= x0;
+    }
+    x0 += ks[(chunk + 1) % 3];
+    x1 += ks[(chunk + 2) % 3] + static_cast<uint32_t>(chunk + 1);
+  }
+  *o0 = x0;
+  *o1 = x1;
+}
+
+// ---- draw discipline (engine/rng.py Draw) -------------------------------
+constexpr uint32_t kPurposePollCost = 0;
+constexpr uint32_t kPurposeClogJitter = 1;
+constexpr uint32_t kPurposeLatency = 8;
+constexpr uint32_t kPurposeLoss = 64;
+constexpr uint32_t kPurposeUser = 128;
+
+struct Draw {
+  uint32_t k0, k1, step;
+  uint32_t bits(uint32_t purpose) const {
+    uint32_t a, b;
+    threefry2x32(k0, k1, step, purpose, &a, &b);
+    return a;
+  }
+  // uniform int64 in [lo, hi): modulo reduction, same bias as the spec
+  int64_t uniform_int(int64_t lo, int64_t hi, uint32_t purpose) const {
+    uint32_t span = static_cast<uint32_t>(hi - lo);
+    if (span == 0) span = 1;
+    return lo + static_cast<int64_t>(bits(purpose) % span);
+  }
+  uint32_t user(uint32_t purpose) const { return bits(kPurposeUser + purpose); }
+  int64_t user_int(int64_t lo, int64_t hi, uint32_t purpose) const {
+    return uniform_int(lo, hi, kPurposeUser + purpose);
+  }
+};
+
+// ---- event kinds (engine/core.py) ---------------------------------------
+constexpr int32_t KIND_KILL = 0;
+constexpr int32_t KIND_RESTART = 1;
+constexpr int32_t KIND_CLOG = 2;
+constexpr int32_t KIND_UNCLOG = 3;
+constexpr int32_t KIND_CLOG_NODE = 4;
+constexpr int32_t KIND_UNCLOG_NODE = 5;
+constexpr int32_t KIND_HALT = 6;
+constexpr int32_t KIND_NOP = 7;
+constexpr int32_t FIRST_USER_KIND = 8;
+
+constexpr int64_t kInf = int64_t{1} << 62;
+constexpr uint64_t kTracePrime = 0x100000001B3ull;
+constexpr uint64_t kTraceMix = 0x9E3779B97F4A7C15ull;
+
+struct Config {  // EngineConfig
+  int64_t pool_size;
+  int64_t lat_min_ns, lat_max_ns;
+  uint32_t loss_u32;
+  int64_t proc_min_ns, proc_max_ns;
+  int64_t clog_backoff_min_ns, clog_backoff_max_ns;
+  int64_t time_limit_ns;  // 0 = unlimited
+};
+
+struct Event {
+  int64_t time;
+  bool valid;
+  int32_t kind, node, src, epoch, retry;
+  int32_t args[4];
+};
+
+// one emit row (Emits)
+struct Emit {
+  bool valid = false;
+  bool send = false;
+  int32_t kind = 0, dst = 0;
+  int64_t delay = 0;
+  int32_t args[4] = {0, 0, 0, 0};
+};
+
+struct Effects {
+  std::vector<Emit> emits;
+  int32_t kill = -1, restart = -1;
+  int32_t clog_a = -1, clog_b = -1, clog_set = -1;
+  bool halt = false;
+};
+
+struct Ctx {
+  int64_t now;
+  int32_t node;
+  const int32_t* state;  // (U,)
+  const int32_t* args;   // (4,)
+  int32_t src;
+  Draw draw;
+};
+
+// Workload interface: mirrors engine Workload. new_state is written by
+// the handler; the engine applies it only when the event dispatches.
+struct Workload {
+  int32_t n_nodes, state_width, n_handlers, max_emits;
+  // handler(h, ctx, new_state_out, effects_out)
+  void (*handler)(int32_t h, const Ctx&, int32_t*, Effects*);
+};
+
+// ---- the step loop (engine/core.py make_step) ---------------------------
+struct Sim {
+  Config cfg;
+  Workload wl;
+  uint64_t seed;
+  int64_t now = 0;
+  uint32_t step = 0;
+  bool halted = false;
+  int64_t halt_time = 0;
+  uint64_t trace = 0;
+  int32_t overflow = 0;
+  int64_t msg_count = 0;
+  std::vector<Event> ev;
+  std::vector<uint8_t> alive;
+  std::vector<int32_t> epoch;
+  std::vector<int32_t> node_state;  // (N,U)
+  std::vector<uint8_t> clog;        // (N,N)
+
+  void init() {
+    ev.assign(cfg.pool_size, Event{0, false, KIND_NOP, 0, -1, 0, 0, {0, 0, 0, 0}});
+    for (int32_t n = 0; n < wl.n_nodes; n++) {
+      ev[n] = Event{0, true, FIRST_USER_KIND, n, -1, 0, 0, {0, 0, 0, 0}};
+    }
+    alive.assign(wl.n_nodes, 1);
+    epoch.assign(wl.n_nodes, 0);
+    node_state.assign(static_cast<size_t>(wl.n_nodes) * wl.state_width, 0);
+    clog.assign(static_cast<size_t>(wl.n_nodes) * wl.n_nodes, 0);
+  }
+
+  void trace_fold(int64_t t, int32_t kind, int32_t node, const int32_t* args) {
+    uint64_t h = static_cast<uint64_t>(t) * kTraceMix;
+    h ^= static_cast<uint64_t>(static_cast<uint32_t>(kind)) << 32;
+    h ^= static_cast<uint64_t>(static_cast<uint32_t>(node)) << 40;
+    uint64_t a0 = static_cast<uint32_t>(args[0]);
+    uint64_t a1 = static_cast<uint32_t>(args[1]);
+    uint64_t a2 = static_cast<uint32_t>(args[2]);
+    uint64_t a3 = static_cast<uint32_t>(args[3]);
+    h ^= a0 ^ (a1 << 8) ^ (a2 << 16) ^ (a3 << 24);
+    trace = trace * kTracePrime + h;
+  }
+
+  void do_step() {
+    const int64_t time_limit = cfg.time_limit_ns ? cfg.time_limit_ns : kInf;
+    // pop earliest (first-min, matching jnp.argmin)
+    int64_t best = kInf;
+    int64_t i = 0;
+    for (int64_t j = 0; j < cfg.pool_size; j++) {
+      int64_t t = ev[j].valid ? ev[j].time : kInf;
+      if (t < best) {
+        best = t;
+        i = j;
+      }
+    }
+    bool has_event = ev[i].valid;
+    int64_t ev_t = ev[i].time > now ? ev[i].time : now;
+    bool over_limit = ev_t > time_limit;
+    bool active = has_event && !halted && !over_limit;
+
+    int32_t kind = ev[i].kind, dst = ev[i].node, src = ev[i].src;
+    int32_t args[4];
+    std::memcpy(args, ev[i].args, sizeof(args));
+    bool is_engine = kind < FIRST_USER_KIND;
+    bool is_msg = src >= 0;
+    bool live = alive[dst] && epoch[dst] == ev[i].epoch;
+    bool clogged =
+        is_msg && clog[static_cast<size_t>(src < 0 ? 0 : src) * wl.n_nodes + dst];
+    bool dispatch = active && !clogged && (is_engine || live);
+
+    if (active) now = ev_t;
+    Draw draw{static_cast<uint32_t>(seed & 0xFFFFFFFFull),
+              static_cast<uint32_t>(seed >> 32), step};
+    int64_t cost = draw.uniform_int(cfg.proc_min_ns, cfg.proc_max_ns, kPurposePollCost);
+    int64_t now_after = dispatch ? now + cost : now;
+
+    // consume / clog-reschedule (engine: resched branch)
+    int32_t retries = ev[i].retry;
+    int64_t shift = retries < 34 ? retries : 34;
+    int64_t backoff = cfg.clog_backoff_min_ns << shift;
+    if (backoff > cfg.clog_backoff_max_ns) backoff = cfg.clog_backoff_max_ns;
+    backoff += draw.uniform_int(0, 1000, kPurposeClogJitter);
+    bool resched = active && clogged;
+    ev[i].valid = resched;
+    if (resched) {
+      ev[i].time = now + backoff;
+      ev[i].retry = retries + 1;
+    }
+
+    // dispatch through the branch table
+    Effects eff;
+    std::vector<int32_t> new_state(wl.state_width);
+    const int32_t* row = &node_state[static_cast<size_t>(dst) * wl.state_width];
+    std::memcpy(new_state.data(), row, wl.state_width * sizeof(int32_t));
+    Ctx ctx{now, dst, row, args, src, draw};
+    int32_t safe_kind = kind < 0 ? 0 : kind;
+    int32_t max_kind = FIRST_USER_KIND + wl.n_handlers - 1;
+    if (safe_kind > max_kind) safe_kind = max_kind;
+    if (safe_kind >= FIRST_USER_KIND) {
+      wl.handler(safe_kind - FIRST_USER_KIND, ctx, new_state.data(), &eff);
+    } else {
+      switch (safe_kind) {
+        case KIND_KILL: eff.kill = args[0]; break;
+        case KIND_RESTART: {
+          eff.restart = args[0];
+          Emit e;  // reborn node re-runs on_init (engine _b_restart)
+          e.valid = true;
+          e.kind = FIRST_USER_KIND;
+          e.dst = args[0];
+          eff.emits.push_back(e);
+          break;
+        }
+        case KIND_CLOG: eff.clog_a = args[0]; eff.clog_b = args[1]; eff.clog_set = 1; break;
+        case KIND_UNCLOG: eff.clog_a = args[0]; eff.clog_b = args[1]; eff.clog_set = 0; break;
+        case KIND_CLOG_NODE: eff.clog_a = args[0]; eff.clog_b = -1; eff.clog_set = 1; break;
+        case KIND_UNCLOG_NODE: eff.clog_a = args[0]; eff.clog_b = -1; eff.clog_set = 0; break;
+        case KIND_HALT: eff.halt = true; break;
+        default: break;  // NOP
+      }
+    }
+
+    // apply node state
+    if (dispatch) {
+      std::memcpy(&node_state[static_cast<size_t>(dst) * wl.state_width],
+                  new_state.data(), wl.state_width * sizeof(int32_t));
+    }
+
+    // chaos effects
+    int32_t kill_id = dispatch ? eff.kill : -1;
+    int32_t restart_id = dispatch ? eff.restart : -1;
+    if (kill_id >= 0 && kill_id < wl.n_nodes) {
+      alive[kill_id] = 0;
+      epoch[kill_id] += 1;
+    }
+    if (restart_id >= 0 && restart_id < wl.n_nodes) {
+      alive[restart_id] = 1;
+      epoch[restart_id] += 1;
+      for (int32_t u = 0; u < wl.state_width; u++)
+        node_state[static_cast<size_t>(restart_id) * wl.state_width + u] = 0;
+    }
+    int32_t clog_set = dispatch ? eff.clog_set : -1;
+    if (clog_set >= 0) {
+      for (int32_t a = 0; a < wl.n_nodes; a++) {
+        for (int32_t b = 0; b < wl.n_nodes; b++) {
+          bool pair_sel = (a == eff.clog_a && b == eff.clog_b) ||
+                          (a == eff.clog_b && b == eff.clog_a);
+          bool node_sel = eff.clog_b < 0 && (a == eff.clog_a || b == eff.clog_a);
+          if (pair_sel || node_sel)
+            clog[static_cast<size_t>(a) * wl.n_nodes + b] = clog_set == 1;
+        }
+      }
+    }
+    bool was_halted = halted;
+    halted = halted || (dispatch && eff.halt) || (has_event && over_limit);
+    if (halted && !was_halted)
+      halt_time = now < time_limit ? now : time_limit;
+
+    // translate emits (static slot index -> latency/loss purposes)
+    int32_t n_sends = 0;
+    std::vector<Emit>& em = eff.emits;
+    int free_cursor = 0;  // index into the free-slot sequence
+    // free slots in pool order (flatnonzero)
+    std::vector<int64_t> free;
+    for (int64_t j = 0; j < cfg.pool_size && static_cast<int32_t>(free.size()) < wl.max_emits; j++)
+      if (!ev[j].valid) free.push_back(j);
+    for (size_t slot = 0; slot < em.size(); slot++) {
+      const Emit& e = em[slot];
+      uint32_t lat_bits = draw.bits(kPurposeLatency + static_cast<uint32_t>(slot));
+      uint32_t loss_bits = draw.bits(kPurposeLoss + static_cast<uint32_t>(slot));
+      uint32_t span = static_cast<uint32_t>(cfg.lat_max_ns - cfg.lat_min_ns);
+      if (span == 0) span = 1;
+      int64_t latency = cfg.lat_min_ns + static_cast<int64_t>(lat_bits % span);
+      bool lost = e.send && loss_bits < cfg.loss_u32;
+      bool e_valid = dispatch && e.valid && !lost;
+      if (e.send && e_valid && !(e.dst >= 0 && e.dst < wl.n_nodes && alive[e.dst]))
+        e_valid = false;
+      if (dispatch && e.valid && e.send) n_sends++;
+      if (!e_valid) continue;
+      if (free_cursor >= static_cast<int>(free.size())) {
+        overflow += 1;  // pool full: dropped (engine `dropped`)
+        continue;
+      }
+      int64_t j = free[free_cursor++];
+      Event& ne = ev[j];
+      ne.valid = true;
+      ne.time = now_after + (e.send ? latency : e.delay);
+      ne.kind = e.kind;
+      ne.node = e.dst;
+      ne.src = e.send ? dst : -1;
+      ne.epoch = e.kind < FIRST_USER_KIND ? 0
+                 : (e.dst >= 0 && e.dst < wl.n_nodes ? epoch[e.dst] : 0);
+      ne.retry = 0;
+      std::memcpy(ne.args, e.args, sizeof(ne.args));
+    }
+    msg_count += n_sends;
+    if (dispatch) trace_fold(now, kind, dst, args);
+    now = now_after;
+    step += 1;
+  }
+};
+
+// ---- workloads (mirrors of models/*.py) ---------------------------------
+
+inline Emit mk_send(int32_t dst, int32_t kind, int32_t a0 = 0, int32_t a1 = 0,
+                    bool when = true) {
+  Emit e;
+  e.valid = when;
+  e.send = true;
+  e.kind = kind;
+  e.dst = dst;
+  e.args[0] = a0;
+  e.args[1] = a1;
+  return e;
+}
+
+inline Emit mk_after(int64_t delay, int32_t kind, int32_t dst, int32_t a0 = 0,
+                     bool when = true) {
+  Emit e;
+  e.valid = when;
+  e.send = false;
+  e.kind = kind;
+  e.dst = dst;
+  e.delay = delay;
+  e.args[0] = a0;
+  return e;
+}
+
+// pingpong (models/pingpong.py): rounds=compiled-in via globals below
+struct PingPongParams {
+  int32_t rounds, n_clients;
+};
+PingPongParams g_pp{10, 2};
+
+void pingpong_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t SERVER = 0;
+  const int32_t K_PING = FIRST_USER_KIND + 1, K_PONG = FIRST_USER_KIND + 2,
+                K_DONE = FIRST_USER_KIND + 3;
+  switch (h) {
+    case 0: {  // on_init
+      bool is_client = ctx.node != SERVER;
+      eff->emits.push_back(mk_send(SERVER, K_PING, 0, ctx.node, is_client));
+      break;
+    }
+    case 1: {  // on_ping at server
+      ns[1] = ctx.state[1] + 1;
+      eff->emits.push_back(mk_send(ctx.args[1], K_PONG, ctx.args[0]));
+      break;
+    }
+    case 2: {  // on_pong at client
+      int32_t seq = ctx.args[0] + 1;
+      ns[0] = seq;
+      bool done = seq >= g_pp.rounds;
+      eff->emits.push_back(mk_send(SERVER, K_PING, seq, ctx.node, !done));
+      eff->emits.push_back(mk_send(SERVER, K_DONE, 0, 0, done));
+      break;
+    }
+    case 3: {  // on_done at server
+      int32_t fin = ctx.state[0] + 1;
+      ns[0] = fin;
+      eff->emits.push_back(
+          mk_after(0, KIND_HALT, 0, 0, fin >= g_pp.n_clients));
+      break;
+    }
+  }
+}
+
+// microbench (models/microbench.py)
+struct MicrobenchParams {
+  int32_t rounds;
+  int64_t delay_min, delay_max;
+};
+MicrobenchParams g_mb{1000, 1000, 1000000};
+
+void microbench_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t K_TICK = FIRST_USER_KIND + 1;
+  switch (h) {
+    case 0: {
+      int64_t d = ctx.draw.user_int(g_mb.delay_min, g_mb.delay_max, 0);
+      eff->emits.push_back(mk_after(d, K_TICK, ctx.node));
+      break;
+    }
+    case 1: {
+      int32_t count = ctx.state[0] + 1;
+      int32_t bits = static_cast<int32_t>(ctx.draw.user(1));
+      ns[0] = count;
+      ns[1] = ctx.state[1] ^ bits;
+      bool done = count >= g_mb.rounds;
+      int64_t d = ctx.draw.user_int(g_mb.delay_min, g_mb.delay_max, 0);
+      eff->emits.push_back(mk_after(d, K_TICK, ctx.node, 0, !done));
+      eff->emits.push_back(mk_after(0, KIND_HALT, 0, 0, done));
+      break;
+    }
+  }
+}
+
+// raft election (models/raft.py)
+struct RaftParams {
+  int32_t n_nodes;
+  int64_t timeout_min, timeout_max;
+};
+RaftParams g_raft{5, 150000000, 300000000};
+
+void raft_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t ROLE = 0, TERM = 1, VOTED = 2, VOTES = 3, TSEQ = 4;
+  const int32_t FOLLOWER = 0, CANDIDATE = 1, LEADER = 2;
+  const int32_t K_TIMEOUT = FIRST_USER_KIND + 1, K_REQVOTE = FIRST_USER_KIND + 2,
+                K_GRANT = FIRST_USER_KIND + 3, K_HEARTBEAT = FIRST_USER_KIND + 4;
+  const int32_t majority = g_raft.n_nodes / 2 + 1;
+  const int32_t N = g_raft.n_nodes;
+  auto arm = [&](int32_t new_seq, bool when) {
+    int64_t d = ctx.draw.user_int(g_raft.timeout_min, g_raft.timeout_max, 0);
+    eff->emits.push_back(mk_after(d, K_TIMEOUT, ctx.node, new_seq, when));
+  };
+  switch (h) {
+    case 0: {  // on_init
+      arm(1, true);
+      ns[TSEQ] = 1;
+      break;
+    }
+    case 1: {  // on_timeout
+      const int32_t* st = ctx.state;
+      bool fire = ctx.args[0] == st[TSEQ] && st[ROLE] != LEADER;
+      int32_t term = st[TERM] + 1;
+      if (fire) {
+        ns[ROLE] = CANDIDATE;
+        ns[TERM] = term;
+        ns[VOTED] = term;
+        ns[VOTES] = 1;
+        ns[TSEQ] = st[TSEQ] + 1;
+      }
+      for (int32_t p = 0; p < N; p++)
+        eff->emits.push_back(
+            mk_send(p, K_REQVOTE, term, ctx.node, fire && p != ctx.node));
+      arm(st[TSEQ] + 1, fire);
+      break;
+    }
+    case 2: {  // on_reqvote
+      const int32_t* st = ctx.state;
+      int32_t term = ctx.args[0], cand = ctx.args[1];
+      int32_t st1[8];
+      std::memcpy(st1, st, sizeof(int32_t) * 5);
+      bool newer = term > st[TERM];
+      if (newer) {
+        st1[TERM] = term;
+        st1[ROLE] = FOLLOWER;
+        st1[VOTES] = 0;
+      }
+      bool grant = term == st1[TERM] && st1[VOTED] < term;
+      std::memcpy(ns, st1, sizeof(int32_t) * 5);
+      if (grant) {
+        ns[VOTED] = term;
+        ns[TSEQ] = st1[TSEQ] + 1;
+      }
+      eff->emits.push_back(mk_send(cand, K_GRANT, term, 0, grant));
+      {
+        int64_t d = ctx.draw.user_int(g_raft.timeout_min, g_raft.timeout_max, 0);
+        eff->emits.push_back(
+            mk_after(d, K_TIMEOUT, ctx.node, st1[TSEQ] + 1, grant));
+      }
+      break;
+    }
+    case 3: {  // on_grant
+      const int32_t* st = ctx.state;
+      int32_t term = ctx.args[0];
+      bool counts = st[ROLE] == CANDIDATE && term == st[TERM];
+      int32_t votes = counts ? st[VOTES] + 1 : st[VOTES];
+      bool wins = counts && votes >= majority;
+      ns[VOTES] = votes;
+      if (wins) ns[ROLE] = LEADER;
+      for (int32_t p = 0; p < N; p++)
+        eff->emits.push_back(
+            mk_send(p, K_HEARTBEAT, term, 0, wins && p != ctx.node));
+      eff->emits.push_back(mk_after(0, KIND_HALT, 0, 0, wins));
+      break;
+    }
+    case 4: {  // on_heartbeat
+      const int32_t* st = ctx.state;
+      int32_t term = ctx.args[0];
+      bool accept = term >= st[TERM];
+      if (accept) {
+        ns[TERM] = term;
+        ns[ROLE] = FOLLOWER;
+        ns[TSEQ] = st[TSEQ] + 1;
+      }
+      arm(st[TSEQ] + 1, accept);
+      break;
+    }
+  }
+}
+
+Workload make_workload(int32_t id) {
+  switch (id) {
+    case 0:  // pingpong
+      return Workload{1 + g_pp.n_clients, 4, 4, 2, pingpong_handler};
+    case 1:  // microbench
+      return Workload{1, 4, 2, 2, microbench_handler};
+    case 2:  // raft
+      return Workload{g_raft.n_nodes, 6, 5, g_raft.n_nodes + 1, raft_handler};
+    default:
+      return Workload{0, 0, 0, 0, nullptr};
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Set workload parameters (mirrors the model factory arguments).
+void oracle_set_pingpong(int32_t rounds, int32_t n_clients) {
+  g_pp = {rounds, n_clients};
+}
+void oracle_set_microbench(int32_t rounds, int64_t dmin, int64_t dmax) {
+  g_mb = {rounds, dmin, dmax};
+}
+void oracle_set_raft(int32_t n_nodes, int64_t tmin, int64_t tmax) {
+  g_raft = {n_nodes, tmin, tmax};
+}
+
+// Run one seed for n_steps; returns 0 on success. Outputs mirror the
+// SimState fields the trace compare checks.
+int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
+                   int64_t pool_size, int64_t lat_min_ns, int64_t lat_max_ns,
+                   uint32_t loss_u32, int64_t proc_min_ns, int64_t proc_max_ns,
+                   int64_t clog_backoff_min_ns, int64_t clog_backoff_max_ns,
+                   int64_t time_limit_ns, int64_t* out_now, uint64_t* out_trace,
+                   int64_t* out_msg_count, int32_t* out_halted,
+                   int64_t* out_halt_time, int32_t* out_overflow,
+                   int32_t* out_node_state /* N*U, may be null */) {
+  Workload wl = make_workload(workload_id);
+  if (wl.n_nodes == 0) return 1;
+  Sim sim;
+  sim.cfg = Config{pool_size, lat_min_ns, lat_max_ns, loss_u32,
+                   proc_min_ns, proc_max_ns, clog_backoff_min_ns,
+                   clog_backoff_max_ns, time_limit_ns};
+  sim.wl = wl;
+  sim.seed = seed;
+  sim.init();
+  for (int64_t s = 0; s < n_steps; s++) sim.do_step();
+  *out_now = sim.now;
+  *out_trace = sim.trace;
+  *out_msg_count = sim.msg_count;
+  *out_halted = sim.halted ? 1 : 0;
+  *out_halt_time = sim.halt_time;
+  *out_overflow = sim.overflow;
+  if (out_node_state) {
+    std::memcpy(out_node_state, sim.node_state.data(),
+                sim.node_state.size() * sizeof(int32_t));
+  }
+  return 0;
+}
+
+// Direct threefry access for RNG unit tests.
+void oracle_threefry2x32(uint32_t k0, uint32_t k1, uint32_t x0, uint32_t x1,
+                         uint32_t* o0, uint32_t* o1) {
+  threefry2x32(k0, k1, x0, x1, o0, o1);
+}
+
+}  // extern "C"

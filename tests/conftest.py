@@ -1,16 +1,24 @@
 """Test-session environment.
 
 JAX tests run on a virtual 8-device CPU platform so multi-chip sharding
-(seed-axis shard_map over a Mesh) is exercised without TPU hardware; the
-driver separately dry-runs the multi-chip path via __graft_entry__.py.
-Must be set before the first `import jax` anywhere in the test session.
+(seed-axis jit/shard_map over a Mesh) is exercised without TPU hardware;
+the driver separately dry-runs the multi-chip path via __graft_entry__.py
+and benches on the real chip.
+
+The platform override uses jax.config.update because the environment may
+pin JAX_PLATFORMS to a TPU plugin via sitecustomize (env vars alone are
+not enough); the XLA flag must still be set before the backend
+initializes, hence both happen here before any test imports jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,209 @@
+"""The asyncio compat shim (madsim-tokio analog): asyncio-written code
+runs deterministically inside the simulator and delegates to the real
+asyncio outside (reference madsim-tokio/src/lib.rs cfg switch)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.compat import asyncio as aio
+
+
+def run(seed, coro_fn, time_limit=60.0):
+    rt = ms.Runtime(seed=seed)
+    rt.set_time_limit(time_limit)
+    return rt.block_on(coro_fn())
+
+
+def test_sleep_uses_virtual_time():
+    async def main():
+        t0 = ms.now_ns()
+        await aio.sleep(5.0)
+        return (ms.now_ns() - t0) / 1e9
+
+    waited = run(1, main)
+    assert waited >= 5.0
+
+
+def test_create_task_gather():
+    async def main():
+        async def work(i):
+            await aio.sleep(0.01 * i)
+            return i * 10
+
+        t = aio.create_task(work(1))
+        assert not t.done()
+        results = await aio.gather(work(2), work(3))
+        assert results == [20, 30]
+        assert await t == 10
+        return True
+
+    assert run(2, main)
+
+
+def test_wait_for_timeout():
+    async def main():
+        with pytest.raises(aio.TimeoutError):
+            await aio.wait_for(aio.sleep(10), timeout=0.5)
+        # and succeeds inside the budget
+        assert await aio.wait_for(aio.sleep(0.1, "done"), timeout=5) == "done"
+        return True
+
+    assert run(3, main)
+
+
+def test_wait_first_completed():
+    async def main():
+        async def fast():
+            await aio.sleep(0.1)
+            return "fast"
+
+        async def slow():
+            await aio.sleep(9.0)
+            return "slow"
+
+        done, pending = await aio.wait(
+            [fast(), slow()], return_when=aio.FIRST_COMPLETED
+        )
+        assert len(done) == 1 and len(pending) == 1
+        assert next(iter(done)).result() == "fast"
+        for p in pending:
+            p.cancel()
+        return True
+
+    assert run(4, main)
+
+
+def test_queue_producer_consumer():
+    async def main():
+        q = aio.Queue(maxsize=2)
+        got = []
+
+        async def producer():
+            for i in range(6):
+                await q.put(i)
+
+        async def consumer():
+            for _ in range(6):
+                got.append(await q.get())
+
+        p = aio.create_task(producer())
+        c = aio.create_task(consumer())
+        await p
+        await c
+        assert got == list(range(6))
+        # bounded: put_nowait raises when full
+        q2 = aio.Queue(maxsize=1)
+        q2.put_nowait(1)
+        with pytest.raises(aio.QueueFull):
+            q2.put_nowait(2)
+        return True
+
+    assert run(5, main)
+
+
+def test_priority_and_lifo_queue():
+    async def main():
+        pq = aio.PriorityQueue()
+        for x in (3, 1, 2):
+            pq.put_nowait(x)
+        assert [pq.get_nowait() for _ in range(3)] == [1, 2, 3]
+        lq = aio.LifoQueue()
+        for x in (1, 2, 3):
+            lq.put_nowait(x)
+        assert [lq.get_nowait() for _ in range(3)] == [3, 2, 1]
+        return True
+
+    assert run(6, main)
+
+
+def test_lock_event_semaphore():
+    async def main():
+        lock = aio.Lock()
+        order = []
+
+        async def worker(i):
+            async with lock:
+                order.append(("enter", i))
+                await aio.sleep(0.1)
+                order.append(("exit", i))
+
+        await aio.gather(worker(1), worker(2))
+        # mutual exclusion: enter/exit strictly paired
+        assert order[0][0] == "enter" and order[1] == ("exit", order[0][1])
+
+        ev = aio.Event()
+        seen = []
+
+        async def waiter():
+            await ev.wait()
+            seen.append(True)
+
+        t = aio.create_task(waiter())
+        await aio.sleep(0.05)
+        assert not seen
+        ev.set()
+        await t
+        assert seen == [True]
+
+        sem = aio.BoundedSemaphore(1)
+        async with sem:
+            assert sem.locked()
+        with pytest.raises(ValueError):
+            sem.release()
+        return True
+
+    assert run(7, main)
+
+
+def test_shim_is_deterministic():
+    def scenario(seed):
+        events = []
+
+        async def main():
+            q = aio.Queue()
+
+            async def noisy(i):
+                await aio.sleep(ms.random() * 0.1)
+                await q.put(i)
+
+            for i in range(5):
+                aio.create_task(noisy(i))
+            for _ in range(5):
+                events.append((await q.get(), round(ms.now_ns() / 1e6, 3)))
+
+        run(seed, main)
+        return events
+
+    assert scenario(11) == scenario(11)
+    assert scenario(11) != scenario(12)
+
+
+def test_outside_sim_delegates_to_real_asyncio():
+    import asyncio as real
+
+    async def main():
+        await aio.sleep(0)
+        t = aio.create_task(aio.sleep(0, "x"))
+        return await t
+
+    assert real.run(main()) == "x"
+    # sync primitives constructed outside a sim are the real classes
+    assert isinstance(aio.Queue(), real.Queue)
+    assert isinstance(aio.Lock(), real.Lock)
+
+
+def test_install_uninstall():
+    import sys
+
+    from madsim_tpu import compat
+
+    compat.install()
+    try:
+        import asyncio
+
+        assert asyncio is aio
+    finally:
+        compat.uninstall()
+    import asyncio
+
+    assert asyncio is not aio

@@ -1,0 +1,95 @@
+"""Bit-identical trace compare: batched JAX engine vs the C++ oracle.
+
+The strongest determinism check in the framework (SURVEY.md §2.6 row 4,
+§7 hard part 2): the C++ oracle (native/oracle.cpp) reimplements the
+engine's integer semantics and workloads independently; for any
+(workload, seed, config) both must produce the identical uint64 rolling
+trace hash, virtual clock, message count and final node state. This is
+what licenses trusting a 65k-seed TPU batch — each row provably equals
+the reference interpreter.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+
+from madsim_tpu.engine import EngineConfig, make_init, make_run, threefry2x32
+from madsim_tpu.engine.oracle import oracle_threefry, run_oracle
+from madsim_tpu.models import make_microbench, make_pingpong, make_raft
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("make") is None or shutil.which("g++") is None,
+    reason="native toolchain unavailable",
+)
+
+
+def engine_batch(wl, cfg, seeds, n_steps):
+    init = make_init(wl, cfg)
+    run = jax.jit(make_run(wl, cfg, n_steps))
+    return run(init(np.asarray(seeds, np.uint64)))
+
+
+def compare(wl, cfg, seeds, n_steps, **model_kwargs):
+    out = engine_batch(wl, cfg, seeds, n_steps)
+    for idx, seed in enumerate(seeds):
+        o = run_oracle(wl, cfg, seed, n_steps, **model_kwargs)
+        assert int(out.trace[idx]) == o.trace, (
+            f"trace diverged for seed {seed}: "
+            f"engine={int(out.trace[idx]):x} oracle={o.trace:x}"
+        )
+        assert int(out.now[idx]) == o.now
+        assert int(out.msg_count[idx]) == o.msg_count
+        assert bool(out.halted[idx]) == o.halted
+        assert int(out.halt_time[idx]) == o.halt_time
+        assert int(out.overflow[idx]) == o.overflow
+        assert np.array_equal(np.asarray(out.node_state[idx]), o.node_state)
+
+
+def test_threefry_matches_oracle():
+    rng = np.random.RandomState(7)
+    for _ in range(100):
+        k0, k1, x0, x1 = rng.randint(0, 2**32, size=4, dtype=np.uint32)
+        ja, jb = threefry2x32(k0, k1, x0, x1)
+        oa, ob = oracle_threefry(int(k0), int(k1), int(x0), int(x1))
+        assert (int(np.uint32(ja)), int(np.uint32(jb))) == (oa, ob)
+
+
+def test_pingpong_traces_bit_identical():
+    wl = make_pingpong(rounds=5)
+    cfg = EngineConfig(pool_size=64)
+    compare(wl, cfg, list(range(16)), 200, rounds=5)
+
+
+def test_pingpong_with_loss_bit_identical():
+    wl = make_pingpong(rounds=3)
+    cfg = EngineConfig(pool_size=64, loss_p=0.2)
+    compare(wl, cfg, list(range(8)), 150, rounds=3)
+
+
+def test_microbench_traces_bit_identical():
+    wl = make_microbench(rounds=200)
+    cfg = EngineConfig(pool_size=16)
+    compare(wl, cfg, list(range(8)), 220, rounds=200)
+
+
+def test_raft_traces_bit_identical():
+    wl = make_raft()
+    cfg = EngineConfig(pool_size=128, loss_p=0.05)
+    compare(wl, cfg, list(range(16)), 400)
+
+
+def test_raft_with_time_limit_bit_identical():
+    wl = make_raft()
+    cfg = EngineConfig(pool_size=128, time_limit_ns=200_000_000)
+    compare(wl, cfg, [3, 9, 27], 400)
+
+
+def test_big_seed_values():
+    # seeds above 2^32 exercise the k1 half of the key
+    wl = make_pingpong(rounds=3)
+    cfg = EngineConfig(pool_size=64)
+    seeds = [2**63 - 1, 2**40 + 17, 123456789012345]
+    compare(wl, cfg, seeds, 150, rounds=3)

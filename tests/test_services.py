@@ -1,0 +1,622 @@
+"""Service-simulator integration tests.
+
+Mirrors the reference's integration suites (SURVEY.md §4):
+  * tonic-example/src/server.rs:129-406 — unary + streaming RPC shapes,
+    invalid address, client_crash (random-time client restarts),
+    client-drops-stream, server_crash => UNAVAILABLE
+  * madsim-etcd-client tests — kv/txn/lease/election semantics + fault
+    injection
+  * madsim-rdkafka/tests/test.rs:20-169 — multi-node producers/consumers
+    exactly-once sum check
+"""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.services import etcd, grpc, kafka
+
+
+def run(seed, coro_fn, config=None, time_limit=120.0):
+    rt = ms.Runtime(seed=seed, config=config)
+    rt.set_time_limit(time_limit)
+    return rt.block_on(coro_fn())
+
+
+# ---------------------------------------------------------------------------
+# gRPC-style services (tonic parity)
+# ---------------------------------------------------------------------------
+
+
+class Greeter:
+    """The tonic-example service shape (4 RPC kinds)."""
+
+    SERVICE_NAME = "helloworld.Greeter"
+
+    async def say_hello(self, request):
+        return {"message": f"Hello {request.message['name']}!"}
+
+    async def lots_of_replies(self, request):
+        for i in range(5):
+            await ms.sleep(0.01)
+            yield {"message": f"{request.message['name']}#{i}"}
+
+    async def record_hellos(self, stream):
+        names = []
+        async for msg in stream:
+            names.append(msg["name"])
+        return {"message": f"Hello {', '.join(names)}!"}
+
+    async def chat(self, stream):
+        async for msg in stream:
+            yield {"message": f"ack:{msg['name']}"}
+
+
+def _spawn_greeter(h, ip="10.0.0.1", port=50051):
+    async def serve():
+        await grpc.Server.builder().add_service(Greeter()).serve(f"0.0.0.0:{port}")
+
+    node = h.create_node().name("grpc-server").ip(ip).init(serve).build()
+    return node, f"{ip}:{port}"
+
+
+def test_grpc_unary():
+    async def main():
+        h = ms.Handle.current()
+        _, addr = _spawn_greeter(h)
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            r = await c.say_hello({"name": "world"})
+            assert r == {"message": "Hello world!"}
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(1, main)
+
+
+def test_grpc_server_streaming():
+    async def main():
+        h = ms.Handle.current()
+        _, addr = _spawn_greeter(h)
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            stream = await c.lots_of_replies({"name": "x"})
+            msgs = [m async for m in stream]
+            assert [m["message"] for m in msgs] == [f"x#{i}" for i in range(5)]
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(2, main)
+
+
+def test_grpc_client_streaming_and_bidi():
+    async def main():
+        h = ms.Handle.current()
+        _, addr = _spawn_greeter(h)
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            tx, reply = await c.record_hellos()
+            for n in ("a", "b", "c"):
+                await tx.send({"name": n})
+            await tx.finish()
+            r = await reply
+            assert r == {"message": "Hello a, b, c!"}
+
+            tx, stream = await c.chat()
+            await tx.send({"name": "1"})
+            assert (await stream.message())["message"] == "ack:1"
+            await tx.send({"name": "2"})
+            assert (await stream.message())["message"] == "ack:2"
+            await tx.finish()
+            assert await stream.message() is None
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(3, main)
+
+
+def test_grpc_invalid_address_unavailable():
+    """Connecting to an unbound address fails fast with UNAVAILABLE
+    (tonic-example invalid-address test)."""
+
+    async def main():
+        h = ms.Handle.current()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            with pytest.raises(grpc.Status) as ei:
+                await grpc.connect("10.9.9.9:1")
+            assert ei.value.code == grpc.Code.UNAVAILABLE
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(4, main)
+
+
+def test_grpc_server_crash_unavailable():
+    """Kill the server mid-session: in-flight and subsequent calls fail
+    UNAVAILABLE (tonic-example/src/server.rs:371-405)."""
+
+    async def main():
+        h = ms.Handle.current()
+        server, addr = _spawn_greeter(h)
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            r = await c.say_hello({"name": "a"})
+            assert r["message"] == "Hello a!"
+            h.kill(server)
+            with pytest.raises(grpc.Status) as ei:
+                await c.say_hello({"name": "b"})
+            assert ei.value.code == grpc.Code.UNAVAILABLE
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(5, main)
+
+
+def test_grpc_client_crash_server_survives():
+    """Clients killed at random times mid-call; the server keeps serving
+    (tonic-example/src/server.rs:283-331)."""
+
+    async def main():
+        h = ms.Handle.current()
+        _, addr = _spawn_greeter(h)
+
+        for i in range(10):
+            async def client():
+                ch = await grpc.connect(addr)
+                c = grpc.service_client(Greeter, ch)
+                while True:
+                    await c.say_hello({"name": "spin"})
+
+            node = h.create_node().name(f"victim{i}").ip(f"10.0.1.{i+1}").build()
+            node.spawn(client())
+            await ms.sleep(ms.thread_rng().random_float() * 0.5)
+            h.kill(node)
+
+        # server must still answer a fresh client
+        probe = h.create_node().name("probe").ip("10.0.0.99").build()
+
+        async def check():
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            r = await c.say_hello({"name": "still-alive"})
+            return r["message"]
+
+        assert await probe.spawn(check()) == "Hello still-alive!"
+        return True
+
+    assert run(6, main)
+
+
+def test_grpc_client_drops_stream():
+    """Client abandons a bidi stream without finishing; the server-side
+    handler ends instead of hanging (server.rs:333-369)."""
+
+    async def main():
+        h = ms.Handle.current()
+        _, addr = _spawn_greeter(h)
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ch = await grpc.connect(addr)
+            c = grpc.service_client(Greeter, ch)
+            tx, stream = await c.chat()
+            await tx.send({"name": "x"})
+            assert (await stream.message())["message"] == "ack:x"
+            tx.drop()  # abandon without end-marker
+            await ms.sleep(1.0)
+            # server still serves new calls afterwards
+            r = await c.say_hello({"name": "after"})
+            assert r["message"] == "Hello after!"
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(7, main)
+
+
+# ---------------------------------------------------------------------------
+# etcd simulator
+# ---------------------------------------------------------------------------
+
+
+def _spawn_etcd(h, timeout_rate=0.0, ip="10.0.2.1", port=2379):
+    async def serve():
+        await etcd.SimServer(timeout_rate=timeout_rate).serve(f"0.0.0.0:{port}")
+
+    h.create_node().name("etcd").ip(ip).init(serve).build()
+    return f"{ip}:{port}"
+
+
+def test_etcd_kv_and_revisions():
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        cli = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c = await etcd.Client.connect([addr])
+            r1 = await c.put("k1", "v1")
+            r2 = await c.put("k1", "v2")
+            assert r2["header_revision"] == r1["header_revision"] + 1
+            g = await c.get("k1")
+            kv = g["kvs"][0]
+            assert kv.value == b"v2" and kv.version == 2
+            assert kv.create_revision == r1["header_revision"]
+            assert kv.mod_revision == r2["header_revision"]
+            # prefix range
+            await c.put("k2", "x")
+            await c.put("other", "y")
+            g = await c.get("k", etcd.GetOptions(prefix=True))
+            assert [kv.key for kv in g["kvs"]] == [b"k1", b"k2"]
+            d = await c.delete("k", etcd.DeleteOptions(prefix=True))
+            assert d["deleted"] == 2
+            g = await c.get("k", etcd.GetOptions(prefix=True))
+            assert g["count"] == 0
+            return True
+
+        return await cli.spawn(app())
+
+    assert run(10, main)
+
+
+def test_etcd_txn():
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        cli = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c = await etcd.Client.connect([addr])
+            await c.put("k", "1")
+            t = (
+                etcd.Txn()
+                .when([etcd.Compare.value("k", "=", "1")])
+                .and_then([etcd.TxnOp.put("k", "2")])
+                .or_else([etcd.TxnOp.put("k", "bad")])
+            )
+            r = await c.txn(t)
+            assert r["succeeded"]
+            assert (await c.get("k"))["kvs"][0].value == b"2"
+            # failing compare takes the else branch
+            r = await c.txn(t)
+            assert not r["succeeded"]
+            assert (await c.get("k"))["kvs"][0].value == b"bad"
+            return True
+
+        return await cli.spawn(app())
+
+    assert run(11, main)
+
+
+def test_etcd_lease_expiry_deletes_keys():
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        cli = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c = await etcd.Client.connect([addr])
+            lease = await c.lease_client().grant(ttl=3)
+            await c.put("ephemeral", "x", etcd.PutOptions(lease=lease["id"]))
+            assert (await c.get("ephemeral"))["count"] == 1
+            # keep-alives hold it
+            for _ in range(4):
+                await ms.sleep(1.0)
+                await c.lease_client().keep_alive(lease["id"])
+            assert (await c.get("ephemeral"))["count"] == 1
+            # stop keep-alive: expires after ttl
+            await ms.sleep(5.0)
+            assert (await c.get("ephemeral"))["count"] == 0
+            with pytest.raises(etcd.EtcdError):
+                await c.lease_client().time_to_live(lease["id"])
+            return True
+
+        return await cli.spawn(app())
+
+    assert run(12, main)
+
+
+def test_etcd_election_campaign_resign():
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        app_node = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c1 = await etcd.Client.connect([addr])
+            c2 = await etcd.Client.connect([addr])
+            l1 = await c1.lease_client().grant(ttl=60)
+            l2 = await c2.lease_client().grant(ttl=60)
+            e1 = c1.election_client()
+            e2 = c2.election_client()
+            win1 = await e1.campaign("mayor", "alice", l1["id"])
+            leader = await e2.leader("mayor")
+            assert leader["kv"].value == b"alice"
+            # second campaign blocks until the first resigns
+            second = ms.spawn(e2.campaign("mayor", "bob", l2["id"]))
+            await ms.sleep(1.0)
+            assert not second.done()
+            await e1.proclaim(win1["key"], "alice2")
+            assert (await e2.leader("mayor"))["kv"].value == b"alice2"
+            await e1.resign(win1["key"])
+            win2 = await second
+            assert (await e1.leader("mayor"))["kv"].value == b"bob"
+            await e2.resign(win2["key"])
+            with pytest.raises(etcd.EtcdError, match="no leader"):
+                await e1.leader("mayor")
+            return True
+
+        return await app_node.spawn(app())
+
+    assert run(13, main)
+
+
+def test_etcd_election_lease_expiry_hands_over():
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        app_node = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c1 = await etcd.Client.connect([addr])
+            c2 = await etcd.Client.connect([addr])
+            l1 = await c1.lease_client().grant(ttl=2)
+            l2 = await c2.lease_client().grant(ttl=60)
+            await c1.election_client().campaign("boss", "a", l1["id"])
+            second = ms.spawn(c2.election_client().campaign("boss", "b", l2["id"]))
+            # let l1 expire (no keep-alive): leadership moves
+            await second
+            assert (await c2.election_client().leader("boss"))["kv"].value == b"b"
+            return True
+
+        return await app_node.spawn(app())
+
+    assert run(14, main)
+
+
+def test_etcd_fault_injection_timeouts():
+    """With timeout_rate=1 every request stalls 5-15s and fails
+    Unavailable (service.rs:113-124)."""
+
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h, timeout_rate=1.0)
+        cli = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c = await etcd.Client.connect([addr])
+            t0 = ms.now_ns()
+            with pytest.raises(etcd.EtcdError, match="Unavailable"):
+                await c.put("k", "v")
+            waited = (ms.now_ns() - t0) / 1e9
+            assert waited >= 5.0
+            return True
+
+        return await cli.spawn(app())
+
+    assert run(15, main)
+
+
+# ---------------------------------------------------------------------------
+# kafka simulator
+# ---------------------------------------------------------------------------
+
+
+def test_kafka_exactly_once_sum():
+    """The reference's rdkafka integration shape (tests/test.rs:20-169):
+    broker + admin + 2 producers + 2 consumers; every produced value is
+    consumed exactly once."""
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.3.1").init(serve).build()
+        addr = "10.0.3.1:9092"
+
+        admin_node = h.create_node().name("admin").ip("10.0.3.2").build()
+
+        async def mk_admin():
+            await ms.sleep(0.1)
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("events", 4)])
+
+        await admin_node.spawn(mk_admin())
+
+        async def producer(base):
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            p = await cfg.create(kafka.FutureProducer)
+            for i in range(50):
+                await p.send(
+                    kafka.BaseRecord.to("events").set_payload(str(base + i))
+                )
+
+        p1 = h.create_node().name("p1").ip("10.0.3.3").build()
+        p2 = h.create_node().name("p2").ip("10.0.3.4").build()
+        j1 = p1.spawn(producer(0))
+        j2 = p2.spawn(producer(1000))
+        await j1
+        await j2
+
+        async def consumer(partitions):
+            cfg = (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("auto.offset.reset", "earliest")
+            )
+            c = await cfg.create(kafka.BaseConsumer)
+            tpl = kafka.TopicPartitionList()
+            for p in partitions:
+                tpl.add_partition("events", p)
+            await c.assign(tpl)
+            got = []
+            idle = 0
+            while idle < 20:
+                msg = await c.poll()
+                if msg is None:
+                    idle += 1
+                    await ms.sleep(0.05)
+                else:
+                    idle = 0
+                    got.append(int(msg.payload))
+            return got
+
+        c1 = h.create_node().name("c1").ip("10.0.3.5").build()
+        c2 = h.create_node().name("c2").ip("10.0.3.6").build()
+        g1 = await c1.spawn(consumer([0, 1]))
+        g2 = await c2.spawn(consumer([2, 3]))
+        all_vals = sorted(g1 + g2)
+        expect = sorted(list(range(50)) + list(range(1000, 1050)))
+        assert all_vals == expect, "every value consumed exactly once"
+        return True
+
+    assert run(20, main)
+
+
+def test_kafka_producer_queue_full_and_round_robin():
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.3.1").init(serve).build()
+        addr = "10.0.3.1:9092"
+        app = h.create_node().name("app").ip("10.0.3.2").build()
+
+        async def go():
+            await ms.sleep(0.1)
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("t", 3)])
+            p = await cfg.create(kafka.BaseProducer)
+            for i in range(10):
+                p.send(kafka.BaseRecord.to("t").set_payload(str(i)))
+            # 11th buffered record: QueueFull (producer.rs:173-190)
+            with pytest.raises(kafka.KafkaError, match="QueueFull"):
+                p.send(kafka.BaseRecord.to("t").set_payload("x"))
+            acks = await p.flush()
+            # round-robin across 3 partitions even though none requested
+            assert [part for (_t, part, _o) in acks] == [
+                0, 1, 2, 0, 1, 2, 0, 1, 2, 0
+            ]
+            # requested partition is ignored (broker.rs:81-111)
+            fp = await cfg.create(kafka.FutureProducer)
+            part, off = await fp.send(
+                kafka.BaseRecord.to("t").set_partition(2).set_payload("y")
+            )
+            assert part == 1  # round-robin cursor continues
+            return True
+
+        return await app.spawn(go())
+
+    assert run(21, main)
+
+
+def test_kafka_transactions_and_stream_consumer():
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.3.1").init(serve).build()
+        addr = "10.0.3.1:9092"
+        app = h.create_node().name("app").ip("10.0.3.2").build()
+
+        async def go():
+            await ms.sleep(0.1)
+            cfg = (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("auto.offset.reset", "earliest")
+            )
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("t", 1)])
+
+            p = await cfg.create(kafka.BaseProducer)
+            await p.init_transactions()
+            p.begin_transaction()
+            p.send(kafka.BaseRecord.to("t").set_payload("aborted"))
+            p.abort_transaction()
+            p.begin_transaction()
+            p.send(kafka.BaseRecord.to("t").set_payload("committed"))
+            await p.commit_transaction()
+
+            c = await cfg.create(kafka.StreamConsumer)
+            tpl = kafka.TopicPartitionList()
+            tpl.add_partition_offset("t", 0, kafka.Offset("beginning"))
+            await c.assign(tpl)
+            msg = await c.recv()
+            assert msg.payload == b"committed"
+            lo, hi = await c.fetch_watermarks("t", 0)
+            assert (lo, hi) == (0, 1), "aborted record never reached the log"
+            return True
+
+        return await app.spawn(go())
+
+    assert run(22, main)
+
+
+def test_services_deterministic_across_seeds():
+    """Same seed => same interleaving for a grpc+etcd workload."""
+
+    def scenario(seed):
+        events = []
+
+        async def main():
+            h = ms.Handle.current()
+            _, addr = _spawn_greeter(h)
+            eaddr = _spawn_etcd(h)
+            cli = h.create_node().name("cli").ip("10.0.0.2").build()
+
+            async def go():
+                await ms.sleep(0.1)
+                ch = await grpc.connect(addr)
+                c = grpc.service_client(Greeter, ch)
+                ec = await etcd.Client.connect([eaddr])
+                for i in range(5):
+                    r = await c.say_hello({"name": str(i)})
+                    await ec.put(f"k{i}", r["message"])
+                    events.append((round(ms.now_ns() / 1e6, 3), r["message"]))
+                return True
+
+            return await cli.spawn(go())
+
+        run(seed, main)
+        return events
+
+    assert scenario(42) == scenario(42)
+    assert scenario(42) != scenario(43)

@@ -23,7 +23,7 @@ import numpy as np
 def main() -> None:
     import jax
 
-    from madsim_tpu.engine import EngineConfig, make_init, make_run
+    from madsim_tpu.engine import EngineConfig, make_init, make_run_while
     from madsim_tpu.models import make_raft
 
     n_seeds = int(os.environ.get("BENCH_SEEDS", "8192"))
@@ -32,7 +32,9 @@ def main() -> None:
     wl = make_raft()
     cfg = EngineConfig(pool_size=128, loss_p=0.02)
     init = make_init(wl, cfg)
-    run = jax.jit(make_run(wl, cfg, n_steps))
+    # while-loop runner: stops as soon as every seed halts (no wasted
+    # lockstep iterations on the tail); donation reuses the state buffers
+    run = jax.jit(make_run_while(wl, cfg, n_steps), donate_argnums=0)
 
     state = init(np.arange(n_seeds, dtype=np.uint64))
     # warm-up: compile (first TPU compile is slow; cached afterwards)

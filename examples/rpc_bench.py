@@ -1,0 +1,71 @@
+"""RPC benchmark harness — the criterion bench analog (C31).
+
+The reference defines (but never records) two workloads on its *std*
+runtime (madsim/benches/rpc.rs:11-55): empty-RPC latency and RPC
+throughput with 16 B - 1 MiB payloads over real TCP loopback. Same
+workloads here on the std backend:
+
+    python examples/rpc_bench.py
+"""
+
+import asyncio
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from madsim_tpu.std import net as std_net
+
+
+class Empty:
+    pass
+
+
+class Payload:
+    def __init__(self, n):
+        self.n = n
+
+
+async def main():
+    server = await std_net.Endpoint.bind("127.0.0.1:0")
+    client = await std_net.Endpoint.bind("127.0.0.1:0")
+
+    async def empty(req):
+        return None
+
+    async def payload(req, data):
+        return len(data), data
+
+    server.add_rpc_handler(Empty, empty)
+    server.add_rpc_handler_with_data(Payload, payload)
+    addr = server.local_addr
+
+    # empty-RPC latency (rpc.rs:11-26)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        await client.call(addr, Empty())
+    dt = time.perf_counter() - t0
+    print(f"empty rpc: {dt / n * 1e6:.1f} us/op  ({n / dt:.0f} op/s)")
+
+    # payload throughput 16 B - 1 MiB (rpc.rs:28-55)
+    for size in (16, 256, 4096, 65536, 1 << 20):
+        data = b"\x00" * size
+        reps = max(4, min(500, (64 << 20) // max(size, 1) // 8))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            got_n, _ = await client.call_with_data(addr, Payload(size), data)
+            assert got_n == size
+        dt = time.perf_counter() - t0
+        mb = size * reps * 2 / 1e6  # both directions
+        print(
+            f"payload {size:>8}B: {dt / reps * 1e6:>8.1f} us/op  "
+            f"{mb / dt:>8.1f} MB/s"
+        )
+
+    await server.close()
+    await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -99,33 +99,39 @@ async def wait_for(aw, timeout: Optional[float]):
 
 
 class timeout:
-    """``async with asyncio.timeout(5):`` — py3.11 API. In simulation the
-    deadline runs on virtual time; entering is free, and expiry raises
-    TimeoutError at the await that blows the budget (simplified: the
-    block is wrapped task-less, checked on exit)."""
+    """``async with asyncio.timeout(5):`` — py3.11 API. In simulation a
+    virtual-time timer injects TimeoutError into the task at whatever
+    await point it is parked on when the deadline expires — the same
+    cancel-the-body semantics as real asyncio, so liveness guards keep
+    working on code that blocks forever."""
 
     def __init__(self, delay: Optional[float]):
         self._delay = delay
         self._real_cm = None
-        self._t0 = None
+        self._armed = False
 
     async def __aenter__(self):
         if not _sim():
             self._real_cm = _real.timeout(self._delay)
             return await self._real_cm.__aenter__()
-        from ..runtime.time_ import now_ns
+        if self._delay is not None:
+            handle = context.current_handle()
+            task = context.current_task()
+            self._armed = True
 
-        self._t0 = now_ns()
+            def fire() -> None:
+                if self._armed and not task.finished:
+                    self._armed = False
+                    task.throw_soon(TimeoutError())
+                    handle.executor._schedule(task)
+
+            handle.time.add_timer(max(self._delay, 0.0), fire)
         return self
 
     async def __aexit__(self, et, ev, tb):
         if self._real_cm is not None:
             return await self._real_cm.__aexit__(et, ev, tb)
-        from ..runtime.time_ import now_ns
-
-        if et is None and self._delay is not None:
-            if (now_ns() - self._t0) / 1e9 > self._delay:
-                raise TimeoutError
+        self._armed = False
         return False
 
 

@@ -35,9 +35,12 @@ from .core import (  # noqa: E402,F401
     Workload,
     make_init,
     make_run,
+    make_run_while,
     make_step,
     user_kind,
 )
+from .checkpoint import load as load_checkpoint  # noqa: E402,F401
+from .checkpoint import save as save_checkpoint  # noqa: E402,F401
 from .rng import (  # noqa: E402,F401
     Draw,
     chance_threshold,

@@ -1,0 +1,59 @@
+"""Checkpoint / resume for batched simulation state.
+
+The reference has no checkpointing — reproducibility comes from
+replaying the seed (SURVEY.md §5 "Checkpoint/resume: none"). For the
+batched engine a checkpoint is just the state arrays, so saving and
+resuming a 65k-seed run is cheap and worth having: long chaos searches
+can snapshot progress, and a snapshot plus the (workload, config) pair
+deterministically resumes to the same trajectory as the uninterrupted
+run (the test asserts that).
+
+Format: a single .npz with one entry per SimState field plus a manifest
+entry recording the config hash, so resuming under a different config —
+which would silently change the trajectory — is rejected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core import EngineConfig, SimState
+
+__all__ = ["save", "load"]
+
+_MANIFEST_KEY = "__madsim_manifest__"
+_FORMAT = 1
+
+
+def save(path: str, state: SimState, cfg: EngineConfig) -> None:
+    """Write a batched SimState to ``path`` (.npz)."""
+    arrays = {
+        f.name: np.asarray(getattr(state, f.name))
+        for f in dataclasses.fields(state)
+    }
+    manifest = json.dumps({"format": _FORMAT, "config_hash": cfg.hash()})
+    arrays[_MANIFEST_KEY] = np.frombuffer(manifest.encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+
+
+def load(path: str, cfg: EngineConfig) -> SimState:
+    """Load a SimState; refuses a checkpoint taken under another config."""
+    with np.load(path) as data:
+        manifest = json.loads(bytes(data[_MANIFEST_KEY]).decode())
+        if manifest.get("format") != _FORMAT:
+            raise ValueError(f"unknown checkpoint format {manifest.get('format')}")
+        if manifest["config_hash"] != cfg.hash():
+            raise ValueError(
+                "checkpoint was taken under a different EngineConfig "
+                f"({manifest['config_hash']} != {cfg.hash()}); resuming would "
+                "silently change the simulation trajectory"
+            )
+        fields = {
+            f.name: jnp.asarray(data[f.name]) for f in dataclasses.fields(SimState)
+        }
+    return SimState(**fields)

@@ -643,14 +643,14 @@ def make_step(wl: Workload, cfg: EngineConfig):
             dispatch & em.valid & em.send
         ).astype(jnp.int64)
 
-        ev_valid = ev_valid.at[free].set(e_valid, mode="drop")
-        ev_time = ev_time.at[free].set(e_time, mode="drop")
-        ev_kind = st.ev_kind.at[free].set(em.kind, mode="drop")
-        ev_node = st.ev_node.at[free].set(em.dst, mode="drop")
-        ev_src = st.ev_src.at[free].set(e_src, mode="drop")
-        ev_epoch = st.ev_epoch.at[free].set(e_epoch, mode="drop")
-        ev_retry = ev_retry.at[free].set(jnp.zeros((k,), jnp.int32), mode="drop")
-        ev_args = st.ev_args.at[free].set(em.args, mode="drop")
+        ev_valid = ev_valid.at[slot].set(e_valid, mode="drop")
+        ev_time = ev_time.at[slot].set(e_time, mode="drop")
+        ev_kind = st.ev_kind.at[slot].set(em.kind, mode="drop")
+        ev_node = st.ev_node.at[slot].set(em.dst, mode="drop")
+        ev_src = st.ev_src.at[slot].set(e_src, mode="drop")
+        ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
+        ev_retry = ev_retry.at[slot].set(jnp.zeros((k,), jnp.int32), mode="drop")
+        ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
 
         # ---- trace + clock ----
         trace = jnp.where(
@@ -697,6 +697,33 @@ def make_run(wl: Workload, cfg: EngineConfig, n_steps: int):
             return step(s), None
 
         final, _ = lax.scan(body, state, None, length=n_steps)
+        return final
+
+    return run
+
+
+def make_run_while(wl: Workload, cfg: EngineConfig, max_steps: int):
+    """Like :func:`make_run` but stops as soon as every seed has halted.
+
+    ``lax.while_loop`` on device: no wasted lockstep iterations once the
+    slowest seed finishes — the bench path for halting workloads (e.g.
+    raft elections, where the tail of seeds needing a second election
+    round would otherwise cost every seed the full max_steps). Note the
+    all-halted reduction runs per iteration; with a sharded seed axis it
+    is XLA's only collective in the loop (a cheap scalar all-reduce).
+    """
+    step = jax.vmap(make_step(wl, cfg))
+
+    def run(state: SimState) -> SimState:
+        def cond(carry):
+            s, i = carry
+            return (i < max_steps) & ~jnp.all(s.halted)
+
+        def body(carry):
+            s, i = carry
+            return step(s), i + 1
+
+        final, _ = lax.while_loop(cond, body, (state, jnp.int64(0)))
         return final
 
     return run

@@ -6,6 +6,10 @@ from .endpoint import Endpoint, PipeReceiver, PipeSender
 from .netsim import NetSim
 from .network import Network, Stat
 from .rpc import add_rpc_handler, add_rpc_handler_with_data, call, call_with_data, rpc_id
+# NOTE: the @rpc decorator is deliberately NOT re-exported here — it
+# would shadow the `net.rpc` submodule. Import it from the service
+# module: `from madsim_tpu.net.service import rpc, service`.
+from .service import service
 from .tcp import TcpListener, TcpStream
 from .udp import UdpSocket
 

@@ -239,23 +239,25 @@ class Endpoint:
 
     # ---- typed RPC sugar (C12; implemented in net/rpc.py) ---------------
     async def call(self, dst: AddrLike, req: Any, timeout: Optional[float] = None) -> Any:
-        from . import rpc
+        # import the submodule explicitly: the package re-exports the @rpc
+        # decorator under the same name, shadowing `from . import rpc`
+        from .rpc import call as rpc_call
 
-        return await rpc.call(self, dst, req, timeout=timeout)
+        return await rpc_call(self, dst, req, timeout=timeout)
 
     async def call_with_data(
         self, dst: AddrLike, req: Any, data: bytes, timeout: Optional[float] = None
     ) -> tuple[Any, bytes]:
-        from . import rpc
+        from .rpc import call_with_data as rpc_call_with_data
 
-        return await rpc.call_with_data(self, dst, req, data, timeout=timeout)
+        return await rpc_call_with_data(self, dst, req, data, timeout=timeout)
 
     def add_rpc_handler(self, req_type: type, handler) -> None:
-        from . import rpc
+        from .rpc import add_rpc_handler as rpc_add
 
-        rpc.add_rpc_handler(self, req_type, handler)
+        rpc_add(self, req_type, handler)
 
     def add_rpc_handler_with_data(self, req_type: type, handler) -> None:
-        from . import rpc
+        from .rpc import add_rpc_handler_with_data as rpc_add_wd
 
-        rpc.add_rpc_handler_with_data(self, req_type, handler)
+        rpc_add_wd(self, req_type, handler)

@@ -112,6 +112,7 @@ class Task:
         "scheduled",
         "finished",
         "_close_pending",
+        "_pending_throw",
     )
 
     def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo, name: str):
@@ -123,6 +124,17 @@ class Task:
         self.scheduled = False
         self.finished = False
         self._close_pending = False
+        # exception injected at the task's next poll (the cancellation
+        # mechanism behind compat asyncio.timeout(): the timer arms this
+        # and reschedules the task, and the executor throws it into the
+        # coroutine at its current await point)
+        self._pending_throw: Optional[BaseException] = None
+
+    def throw_soon(self, exc: BaseException) -> None:
+        """Arrange for ``exc`` to be raised inside the coroutine at its
+        current suspension point on the next poll. Caller must schedule
+        the task."""
+        self._pending_throw = exc
 
     def kill(self) -> None:
         """Cancel: close the coroutine (finally blocks run — the analog of
@@ -252,7 +264,11 @@ class Executor:
     def _poll(self, task: Task) -> None:
         try:
             with context.enter_task(task):
-                yielded = task.coro.send(None)
+                if task._pending_throw is not None:
+                    exc_in, task._pending_throw = task._pending_throw, None
+                    yielded = task.coro.throw(exc_in)
+                else:
+                    yielded = task.coro.send(None)
         except StopIteration as stop:
             task.finished = True
             task._fut.set_result(stop.value)

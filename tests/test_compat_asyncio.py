@@ -207,3 +207,27 @@ def test_install_uninstall():
     import asyncio
 
     assert asyncio is not aio
+
+
+def test_timeout_context_manager_interrupts_blocked_body():
+    """`async with asyncio.timeout(..)` must cancel a body blocked on an
+    await that never resolves (the liveness-guard use case)."""
+
+    async def main():
+        import madsim_tpu as ms_
+
+        hung = ms_.SimFuture(name="never")
+        t0 = ms_.now_ns()
+        with pytest.raises(aio.TimeoutError):
+            async with aio.timeout(2.0):
+                await hung
+        waited = (ms_.now_ns() - t0) / 1e9
+        assert 2.0 <= waited < 3.0
+        # a body that finishes in time is unaffected, and the disarmed
+        # timer never fires into later awaits
+        async with aio.timeout(5.0):
+            await aio.sleep(0.1)
+        await aio.sleep(10.0)
+        return True
+
+    assert run(8, main)

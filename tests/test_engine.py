@@ -325,3 +325,49 @@ class TestStats:
         out = run_workload(wl, EngineConfig(pool_size=8), np.arange(4), 20)
         secs = np.asarray(out.sim_seconds)
         assert (secs > 0).all()
+
+
+class TestRunWhileAndCheckpoint:
+    def test_run_while_matches_scan_for_halting_workload(self):
+        from madsim_tpu.engine import make_run_while
+
+        wl = make_pingpong(rounds=4)
+        cfg = EngineConfig(pool_size=64)
+        init = make_init(wl, cfg)
+        st = init(np.arange(8, dtype=np.uint64))
+        scan_out = jax.jit(make_run(wl, cfg, 300))(st)
+        while_out = jax.jit(make_run_while(wl, cfg, 300))(st)
+        assert np.asarray(while_out.halted).all()
+        # halted seeds are frozen, so both paths end in the same state
+        assert np.array_equal(
+            np.asarray(scan_out.trace), np.asarray(while_out.trace)
+        )
+        assert np.array_equal(np.asarray(scan_out.now), np.asarray(while_out.now))
+
+    def test_checkpoint_roundtrip_resumes_identically(self, tmp_path):
+        from madsim_tpu.engine import load_checkpoint, save_checkpoint
+
+        wl = make_raft()
+        cfg = EngineConfig(pool_size=128)
+        init = make_init(wl, cfg)
+        st = init(np.arange(8, dtype=np.uint64))
+        run_half = jax.jit(make_run(wl, cfg, 100))
+        mid = run_half(st)
+        path = str(tmp_path / "ckpt.npz")
+        save_checkpoint(path, mid, cfg)
+        resumed = load_checkpoint(path, cfg)
+        a = run_half(mid)
+        b = run_half(resumed)
+        assert np.array_equal(np.asarray(a.trace), np.asarray(b.trace))
+        assert np.array_equal(np.asarray(a.now), np.asarray(b.now))
+
+    def test_checkpoint_rejects_other_config(self, tmp_path):
+        from madsim_tpu.engine import load_checkpoint, save_checkpoint
+
+        wl = make_microbench(rounds=5)
+        cfg = EngineConfig(pool_size=8)
+        st = make_init(wl, cfg)(np.arange(2, dtype=np.uint64))
+        path = str(tmp_path / "c.npz")
+        save_checkpoint(path, st, cfg)
+        with pytest.raises(ValueError, match="different EngineConfig"):
+            load_checkpoint(path, EngineConfig(pool_size=8, loss_p=0.5))

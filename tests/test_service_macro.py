@@ -1,0 +1,126 @@
+"""@service/@rpc decorator (the #[madsim::service] macro analog,
+madsim-macros/src/service.rs:61-110)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.net import Endpoint
+from madsim_tpu.net.service import rpc, service
+
+
+class Get:
+    def __init__(self, key):
+        self.key = key
+
+
+class Put:
+    def __init__(self, key, value):
+        self.key = key
+        self.value = value
+
+
+@service
+class KvStore:
+    def __init__(self):
+        self.data = {}
+
+    @rpc
+    async def get(self, req: Get):
+        return self.data.get(req.key)
+
+    @rpc
+    async def put(self, req: Put):
+        old = self.data.get(req.key)
+        self.data[req.key] = req.value
+        return old
+
+
+def run(seed, coro_fn):
+    rt = ms.Runtime(seed=seed)
+    rt.set_time_limit(60)
+    return rt.block_on(coro_fn())
+
+
+def test_service_serves_rpc_methods():
+    async def main():
+        h = ms.Handle.current()
+
+        async def server():
+            await KvStore().serve("0.0.0.0:7000")
+
+        h.create_node().name("srv").ip("10.0.0.1").init(server).build()
+        cli = h.create_node().name("cli").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ep = await Endpoint.bind("0.0.0.0:0")
+            assert await ep.call("10.0.0.1:7000", Put("a", 1)) is None
+            assert await ep.call("10.0.0.1:7000", Get("a")) == 1
+            assert await ep.call("10.0.0.1:7000", Put("a", 2)) == 1
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(5, main)
+
+
+def test_service_requires_annotations_and_methods():
+    with pytest.raises(TypeError, match="must annotate"):
+
+        @service
+        class Bad:
+            @rpc
+            async def get(self, req):
+                return None
+
+    with pytest.raises(TypeError, match="no @rpc methods"):
+
+        @service
+        class Empty:
+            async def not_rpc(self):
+                return None
+
+
+def test_serve_on_shared_endpoint():
+    """Two services multiplexed on one endpoint via serve_on."""
+
+    class Ping:
+        pass
+
+    # classes must be distinct request types
+    class Pong:
+        pass
+
+    @service
+    class A:
+        @rpc
+        async def ping(self, req: Ping):
+            return "A"
+
+    @service
+    class B:
+        @rpc
+        async def pong(self, req: Pong):
+            return "B"
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:7000")
+            await A().serve_on(ep)
+            await B().serve_on(ep)
+
+        h.create_node().name("srv").ip("10.0.0.1").init(server).build()
+        cli = h.create_node().name("cli").ip("10.0.0.2").build()
+
+        async def client():
+            await ms.sleep(0.1)
+            ep = await Endpoint.bind("0.0.0.0:0")
+            assert await ep.call("10.0.0.1:7000", Ping()) == "A"
+            assert await ep.call("10.0.0.1:7000", Pong()) == "B"
+            return True
+
+        return await cli.spawn(client())
+
+    assert run(6, main)

@@ -42,11 +42,14 @@ from .runtime import (  # noqa: F401
     NodeBuilder,
     NodeHandle,
     Runtime,
+    SimContextFilter,
+    SimFormatter,
     SimFuture,
     Simulator,
     SystemTime,
     TimeLimitError,
     available_parallelism,
+    init_logger,
     interval,
     join_all,
     main,
@@ -58,6 +61,7 @@ from .runtime import (  # noqa: F401
     simulator,
     sleep,
     sleep_until,
+    span,
     spawn,
     spawn_local,
     test,

@@ -38,7 +38,10 @@ def save(path: str, state: SimState, cfg: EngineConfig) -> None:
     }
     manifest = json.dumps({"format": _FORMAT, "config_hash": cfg.hash()})
     arrays[_MANIFEST_KEY] = np.frombuffer(manifest.encode(), dtype=np.uint8)
-    np.savez(path, **arrays)
+    # write through a file handle so the given path is used verbatim
+    # (np.savez(path_str) would append .npz and break load symmetry)
+    with open(path, "wb") as fh:
+        np.savez(fh, **arrays)
 
 
 def load(path: str, cfg: EngineConfig) -> SimState:

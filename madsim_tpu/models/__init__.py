@@ -9,9 +9,12 @@ benchmark configs in BASELINE.md:
   2. microbench  — single-node timer+rand loop (no network)
   3. broadcast   — 5-node broadcast under latency/loss/partition chaos
   4. raft        — 5-node leader election (the north-star workload)
+  5. kvchaos     — replicated KV cluster with kill/restart chaos and a
+                   majority-durability invariant
 """
 
 from .microbench import make_microbench  # noqa: F401
 from .pingpong import make_pingpong  # noqa: F401
 from .broadcast import make_broadcast  # noqa: F401
 from .raft import make_raft  # noqa: F401
+from .kvchaos import make_kvchaos  # noqa: F401

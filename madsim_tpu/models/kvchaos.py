@@ -1,0 +1,134 @@
+"""Replicated KV cluster under chaos (BASELINE.md config 5 shape).
+
+The batched analog of the reference ecosystem's service-simulator chaos
+tests (etcd/kafka clusters driven by seeded chaos schedules): a
+primary-backup KV store — one primary, ``n_replicas`` backups, one
+client — where every write must be acknowledged by a majority before the
+client sees a commit. The seed schedules replica kills and restarts
+mid-stream; retransmits and re-acks must preserve the invariant the test
+checks: **every committed write is durable on a majority of replicas**.
+
+The run halts when ``writes`` commits have been acknowledged.
+
+Node layout: [primary, replicas 1..R, client R+1]
+Primary state:  [committed_seq, inflight_seq, ack_mask, 0]
+Replica state:  [last_applied_seq, applies, 0, 0]
+Client state:   [commits_seen, 0, 0, 0]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+
+_H_INIT = 0
+_H_WRITE = 1  # at primary: args = (seq,)
+_H_REPL = 2  # at replica: args = (seq,)
+_H_ACK = 3  # at primary: args = (seq, replica)
+_H_COMMIT = 4  # at client: args = (seq,)
+_H_RETX = 5  # at primary: args = (seq,)
+
+PRIMARY = 0
+
+_P_KILL_AT = 0
+_P_KILL_WHO = 1
+_P_REVIVE = 2
+
+
+def make_kvchaos(
+    writes: int = 20,
+    n_replicas: int = 4,
+    retx_ns: int = 40_000_000,
+    chaos: bool = True,
+) -> Workload:
+    n = 1 + n_replicas + 1
+    client = n - 1
+    replicas = list(range(1, 1 + n_replicas))
+    majority = n_replicas // 2 + 1
+
+    def _replicate(eb, seq, when, mask=None):
+        for i, r in enumerate(replicas):
+            w = when if mask is None else (when & (((mask >> i) & 1) == 0))
+            eb.send(r, user_kind(_H_REPL), (seq,), when=w)
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        is_client = ctx.node == jnp.int32(client)
+        # client issues the first write
+        eb.send(PRIMARY, user_kind(_H_WRITE), (jnp.int32(1),), when=is_client)
+        if chaos:
+            # the client doubles as the chaos scheduler: kill a random
+            # replica partway through, restart it later
+            who = ctx.draw.user_int(1, 1 + n_replicas, _P_KILL_WHO).astype(jnp.int32)
+            at = ctx.draw.user_int(20_000_000, 300_000_000, _P_KILL_AT)
+            revive = ctx.draw.user_int(100_000_000, 600_000_000, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=is_client)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=is_client)
+        return ctx.state, eb.build()
+
+    def on_write(ctx):
+        seq = ctx.args[0]
+        st = ctx.state
+        fresh = seq > st[0]
+        new = jnp.where(
+            fresh, st.at[1].set(seq).at[2].set(0), st
+        )
+        eb = ctx.emits()
+        _replicate(eb, seq, fresh)
+        eb.after(retx_ns, user_kind(_H_RETX), PRIMARY, (seq,), when=fresh)
+        return new, eb.build()
+
+    def on_repl(ctx):
+        seq = ctx.args[0]
+        st = ctx.state
+        new = st.at[0].set(jnp.maximum(st[0], seq)).at[1].set(st[1] + 1)
+        eb = ctx.emits()
+        eb.send(PRIMARY, user_kind(_H_ACK), (seq, ctx.node))
+        return new, eb.build()
+
+    def on_ack(ctx):
+        seq, who = ctx.args[0], ctx.args[1]
+        st = ctx.state
+        bit = jnp.int32(1) << (who - 1)
+        current = (seq == st[1]) & (seq > st[0])
+        mask = jnp.where(current, st[2] | bit, st[2])
+        acks = jnp.zeros((), jnp.int32)
+        for i in range(n_replicas):
+            acks = acks + ((mask >> i) & 1)
+        committed = current & (acks >= jnp.int32(majority))
+        new = st.at[2].set(mask)
+        new = jnp.where(committed, new.at[0].set(seq), new)
+        eb = ctx.emits()
+        eb.send(client, user_kind(_H_COMMIT), (seq,), when=committed)
+        return new, eb.build()
+
+    def on_commit(ctx):
+        seq = ctx.args[0]
+        st = ctx.state
+        fresh = seq > st[0]
+        new = jnp.where(fresh, ctx.state.at[0].set(seq), ctx.state)
+        done = seq >= jnp.int32(writes)
+        eb = ctx.emits()
+        eb.send(
+            PRIMARY, user_kind(_H_WRITE), (seq + 1,), when=fresh & ~done
+        )
+        eb.halt(when=fresh & done)
+        return new, eb.build()
+
+    def on_retx(ctx):
+        seq = ctx.args[0]
+        st = ctx.state
+        pending = (seq == st[1]) & (seq > st[0])
+        eb = ctx.emits()
+        _replicate(eb, seq, pending, mask=st[2])
+        eb.after(retx_ns, user_kind(_H_RETX), PRIMARY, (seq,), when=pending)
+        return ctx.state, eb.build()
+
+    return Workload(
+        name="kvchaos",
+        n_nodes=n,
+        state_width=4,
+        handlers=(on_init, on_write, on_repl, on_ack, on_commit, on_retx),
+        max_emits=n_replicas + 2,
+    )

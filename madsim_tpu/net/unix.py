@@ -1,0 +1,42 @@
+"""Unix-domain-socket API placeholders.
+
+Parity with reference madsim/src/sim/net/unix/ (C15): the reference
+ships hidden-doc stubs whose methods are ``todo!()`` — the API surface
+exists so code referencing it compiles, but using it in simulation
+panics. Same contract here: constructing or using these raises
+NotImplementedError.
+"""
+
+from __future__ import annotations
+
+__all__ = ["UnixDatagram", "UnixListener", "UnixStream"]
+
+
+class _Todo:
+    _WHAT = "unix sockets"
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            f"{self._WHAT} are not simulated yet (reference parity: "
+            f"sim/net/unix/ is todo!() stubs)"
+        )
+
+    @classmethod
+    async def bind(cls, *a, **kw):
+        raise NotImplementedError(f"{cls._WHAT} are not simulated yet")
+
+    @classmethod
+    async def connect(cls, *a, **kw):
+        raise NotImplementedError(f"{cls._WHAT} are not simulated yet")
+
+
+class UnixDatagram(_Todo):
+    _WHAT = "unix datagram sockets"
+
+
+class UnixListener(_Todo):
+    _WHAT = "unix listeners"
+
+
+class UnixStream(_Todo):
+    _WHAT = "unix streams"

@@ -9,6 +9,7 @@ from .intercept import available_parallelism
 from .plugin import Simulator, node, simulator
 from .rand import DeterminismError, GlobalRng, random, thread_rng
 from .runtime import DEFAULT_SIMULATORS, Handle, NodeBuilder, NodeHandle, Runtime
+from .trace import SimContextFilter, SimFormatter, init_logger, span
 from .task import (
     DeadlockError,
     JoinError,
@@ -51,6 +52,8 @@ __all__ = [
     "NodeHandle",
     "Runtime",
     "SimFuture",
+    "SimContextFilter",
+    "SimFormatter",
     "Simulator",
     "SystemTime",
     "TcpConfig",
@@ -58,6 +61,7 @@ __all__ = [
     "available_parallelism",
     "current_handle",
     "in_simulation",
+    "init_logger",
     "interval",
     "join_all",
     "main",
@@ -67,6 +71,7 @@ __all__ = [
     "random",
     "select",
     "simulator",
+    "span",
     "sleep",
     "sleep_until",
     "spawn",

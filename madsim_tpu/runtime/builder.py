@@ -118,6 +118,9 @@ def test(fn: Optional[Callable[..., Coroutine]] = None, **builder_kwargs):
     def deco(f: Callable[..., Coroutine]):
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
+            from .trace import init_logger
+
+            init_logger()  # the test macro inits the subscriber once
             b = Builder.from_env()
             for k, v in builder_kwargs.items():
                 setattr(b, k, v)

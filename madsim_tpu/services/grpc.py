@@ -195,10 +195,24 @@ def _route_key(service_name: str, method: str) -> str:
     return f"/{service_name}/{method}"
 
 
-def _classify(func: Callable) -> str:
-    """unary | client_stream | server_stream | bidi, by signature:
-    an async-generator handler streams responses; a handler whose single
-    argument is annotated/named as a stream consumes a request stream."""
+def _classify(func: Callable, owner: Optional[type] = None) -> str:
+    """unary | client_stream | server_stream | bidi.
+
+    Explicit ``__rpc_shape__`` markers win (set by the .proto codegen,
+    grpc_codegen.py — checked through the owner's MRO so user overrides
+    of generated servicer methods keep the declared shape); otherwise
+    classify by signature: an async-generator handler streams responses,
+    and a handler whose single argument is annotated/named as a stream
+    consumes a request stream."""
+    marked = getattr(func, "__rpc_shape__", None)
+    if marked is not None:
+        return marked
+    if owner is not None:
+        name = getattr(func, "__name__", None)
+        for klass in getattr(owner, "__mro__", ()):
+            base = klass.__dict__.get(name)
+            if base is not None and getattr(base, "__rpc_shape__", None):
+                return base.__rpc_shape__
     wants_stream = False
     params = [
         p
@@ -265,7 +279,7 @@ class Router:
             func = getattr(svc, method_name)
             if method_name.startswith("_") or not callable(func):
                 raise KeyError(method_name)
-            shape = _classify(func)
+            shape = _classify(func, owner=type(svc))
         except (ValueError, KeyError, AttributeError, TypeError):
             try:
                 await tx.send((_ERR, Status.unimplemented(f"unknown path {path}")))
@@ -454,7 +468,9 @@ def service_client(service: type | str, channel: Channel):
     for name, func in inspect.getmembers(service, inspect.isfunction):
         if name.startswith("_"):
             continue
-        shape = _classify(func)
+        # owner=service: overrides of codegen servicer methods keep the
+        # declared shape on the client side too (matching the Router)
+        shape = _classify(func, owner=service)
         path = _route_key(svc_name, name)
 
         def make(shape: str, path: str):

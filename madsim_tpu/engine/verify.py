@@ -1,0 +1,54 @@
+"""Engine determinism verification helpers.
+
+The batched analog of the reference's replay determinism checker
+(``check_determinism``, runtime/mod.rs:165-190): run the same seeds
+twice (or on two backends) and compare the uint64 trace hashes — any
+divergence names the first differing seed. The strongest form is the
+C++ oracle compare in engine/oracle.py; this module is the quick
+self-check usable on any workload without an oracle implementation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..runtime.rand import DeterminismError
+from .core import EngineConfig, Workload, make_init, make_run
+
+__all__ = ["check_determinism", "compare_traces"]
+
+
+def compare_traces(a, b, what: str = "run") -> None:
+    """Raise DeterminismError naming the first seed whose traces differ."""
+    ta, tb = np.asarray(a.trace), np.asarray(b.trace)
+    if ta.shape != tb.shape:
+        raise DeterminismError(
+            f"{what}: batch shapes differ ({ta.shape} vs {tb.shape})"
+        )
+    diff = np.nonzero(ta != tb)[0]
+    if diff.size:
+        s = int(diff[0])
+        raise DeterminismError(
+            f"non-determinism detected in {what}: seed index {s} "
+            f"(seed {int(np.asarray(a.seed)[s])}) produced trace "
+            f"{int(ta[s]):#x} vs {int(tb[s]):#x}"
+        )
+
+
+def check_determinism(
+    wl: Workload, cfg: EngineConfig, seeds, n_steps: int
+) -> None:
+    """Run the workload twice over ``seeds``; raise on any divergence.
+
+    Catches hidden nondeterminism in handlers (e.g. float ops that
+    compile differently between runs) the way the reference's two-run
+    RNG-log compare catches nondeterministic user code.
+    """
+    seeds = np.asarray(seeds, np.uint64)
+    init = make_init(wl, cfg)
+    run = jax.jit(make_run(wl, cfg, n_steps))
+    a = run(init(seeds))
+    b = run(init(seeds))
+    compare_traces(a, b, what=f"{wl.name} x2")

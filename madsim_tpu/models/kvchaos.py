@@ -3,15 +3,25 @@
 The batched analog of the reference ecosystem's service-simulator chaos
 tests (etcd/kafka clusters driven by seeded chaos schedules): a
 primary-backup KV store — one primary, ``n_replicas`` backups, one
-client — where every write must be acknowledged by a majority before the
-client sees a commit. The seed schedules replica kills and restarts
-mid-stream; retransmits and re-acks must preserve the invariant the test
-checks: **every committed write is durable on a majority of replicas**.
+client — where a write commits only after a majority of replicas ack.
+The seed schedules a replica kill and restart mid-stream; every message
+kind has a retry path (client re-sends writes, the primary re-replicates
+and re-acks, restarted replicas rejoin and re-sync), so the protocol
+makes progress through loss, partition-grade delays and the crash.
 
-The run halts when ``writes`` commits have been acknowledged.
+Halt condition (checked by the test): the client saw all ``writes``
+commits (it sends FIN), **and** the primary's ack mask for the final
+write is full. Replicas are RAM-only (restart wipes state, the power-
+failure semantics of node reset), so the guarantee provable at halt is:
+the final write was acked by every replica, and is still present on
+every replica **except possibly one crashed after acking within the
+final commit window** — i.e. durable on >= n_replicas-1 always, and on
+all replicas whenever the crash/rejoin resolved before the last write
+(the overwhelmingly common schedule; a restarted replica rejoins with
+periodic JOINs and is re-synced by the retx loop before halt).
 
 Node layout: [primary, replicas 1..R, client R+1]
-Primary state:  [committed_seq, inflight_seq, ack_mask, 0]
+Primary state:  [committed_seq, inflight_seq, ack_mask, fin_seen]
 Replica state:  [last_applied_seq, applies, 0, 0]
 Client state:   [commits_seen, 0, 0, 0]
 """
@@ -28,6 +38,10 @@ _H_REPL = 2  # at replica: args = (seq,)
 _H_ACK = 3  # at primary: args = (seq, replica)
 _H_COMMIT = 4  # at client: args = (seq,)
 _H_RETX = 5  # at primary: args = (seq,)
+_H_CRETX = 6  # at client: periodic progress retry
+_H_FIN = 7  # at primary: client done
+_H_JOIN = 8  # at primary: args = (replica,) — replica (re)joined
+_H_JRETX = 9  # at replica: retry JOIN until synced
 
 PRIMARY = 0
 
@@ -40,26 +54,36 @@ def make_kvchaos(
     writes: int = 20,
     n_replicas: int = 4,
     retx_ns: int = 40_000_000,
+    client_retx_ns: int = 100_000_000,
     chaos: bool = True,
 ) -> Workload:
     n = 1 + n_replicas + 1
     client = n - 1
     replicas = list(range(1, 1 + n_replicas))
     majority = n_replicas // 2 + 1
+    full_mask = (1 << n_replicas) - 1
 
-    def _replicate(eb, seq, when, mask=None):
+    def _replicate(eb, seq, when, mask):
         for i, r in enumerate(replicas):
-            w = when if mask is None else (when & (((mask >> i) & 1) == 0))
-            eb.send(r, user_kind(_H_REPL), (seq,), when=w)
+            eb.send(
+                r, user_kind(_H_REPL), (seq,),
+                when=when & (((mask >> i) & 1) == 0),
+            )
 
     def on_init(ctx):
         eb = ctx.emits()
         is_client = ctx.node == jnp.int32(client)
-        # client issues the first write
+        is_replica = (ctx.node >= 1) & (ctx.node <= jnp.int32(n_replicas))
+        # client kicks off write 1 and its progress-retry timer
         eb.send(PRIMARY, user_kind(_H_WRITE), (jnp.int32(1),), when=is_client)
+        eb.after(client_retx_ns, user_kind(_H_CRETX), client, when=is_client)
+        # replicas announce themselves — at t=0 and again after restart,
+        # which is how the primary learns to re-sync a reborn replica;
+        # retried by a timer until the first write applies (JOINs are
+        # lossy like everything else)
+        eb.send(PRIMARY, user_kind(_H_JOIN), (ctx.node,), when=is_replica)
+        eb.after(retx_ns, user_kind(_H_JRETX), ctx.node, when=is_replica)
         if chaos:
-            # the client doubles as the chaos scheduler: kill a random
-            # replica partway through, restart it later
             who = ctx.draw.user_int(1, 1 + n_replicas, _P_KILL_WHO).astype(jnp.int32)
             at = ctx.draw.user_int(20_000_000, 300_000_000, _P_KILL_AT)
             revive = ctx.draw.user_int(100_000_000, 600_000_000, _P_REVIVE)
@@ -70,12 +94,10 @@ def make_kvchaos(
     def on_write(ctx):
         seq = ctx.args[0]
         st = ctx.state
-        fresh = seq > st[0]
-        new = jnp.where(
-            fresh, st.at[1].set(seq).at[2].set(0), st
-        )
+        fresh = (seq > st[0]) & (seq > st[1])
+        new = jnp.where(fresh, st.at[1].set(seq).at[2].set(0), st)
         eb = ctx.emits()
-        _replicate(eb, seq, fresh)
+        _replicate(eb, seq, fresh, jnp.int32(0))
         eb.after(retx_ns, user_kind(_H_RETX), PRIMARY, (seq,), when=fresh)
         return new, eb.build()
 
@@ -87,48 +109,112 @@ def make_kvchaos(
         eb.send(PRIMARY, user_kind(_H_ACK), (seq, ctx.node))
         return new, eb.build()
 
+    def _maybe_halt(eb, committed, mask, fin):
+        eb.halt(
+            when=(committed >= jnp.int32(writes))
+            & (mask == jnp.int32(full_mask))
+            & (fin > 0)
+        )
+
     def on_ack(ctx):
         seq, who = ctx.args[0], ctx.args[1]
         st = ctx.state
         bit = jnp.int32(1) << (who - 1)
-        current = (seq == st[1]) & (seq > st[0])
+        current = seq == st[1]
         mask = jnp.where(current, st[2] | bit, st[2])
         acks = jnp.zeros((), jnp.int32)
         for i in range(n_replicas):
             acks = acks + ((mask >> i) & 1)
-        committed = current & (acks >= jnp.int32(majority))
-        new = st.at[2].set(mask)
-        new = jnp.where(committed, new.at[0].set(seq), new)
+        committed_now = current & (seq > st[0]) & (acks >= jnp.int32(majority))
+        committed = jnp.where(committed_now, seq, st[0])
+        new = st.at[0].set(committed).at[2].set(mask)
         eb = ctx.emits()
-        eb.send(client, user_kind(_H_COMMIT), (seq,), when=committed)
+        eb.send(
+            client, user_kind(_H_COMMIT), (committed,),
+            when=current & (committed >= seq),
+        )
+        _maybe_halt(eb, committed, mask, st[3])
         return new, eb.build()
 
     def on_commit(ctx):
         seq = ctx.args[0]
         st = ctx.state
         fresh = seq > st[0]
-        new = jnp.where(fresh, ctx.state.at[0].set(seq), ctx.state)
+        new = jnp.where(fresh, st.at[0].set(seq), st)
         done = seq >= jnp.int32(writes)
         eb = ctx.emits()
-        eb.send(
-            PRIMARY, user_kind(_H_WRITE), (seq + 1,), when=fresh & ~done
-        )
-        eb.halt(when=fresh & done)
+        eb.send(PRIMARY, user_kind(_H_WRITE), (seq + 1,), when=fresh & ~done)
+        eb.send(PRIMARY, user_kind(_H_FIN), (), when=fresh & done)
         return new, eb.build()
 
     def on_retx(ctx):
         seq = ctx.args[0]
         st = ctx.state
-        pending = (seq == st[1]) & (seq > st[0])
+        current = seq == st[1]
+        pending_repl = current & (st[2] != jnp.int32(full_mask))
+        # committed but the client may not know (lost COMMIT): re-ack
+        pending_commit = current & (st[0] >= seq)
         eb = ctx.emits()
-        _replicate(eb, seq, pending, mask=st[2])
-        eb.after(retx_ns, user_kind(_H_RETX), PRIMARY, (seq,), when=pending)
+        _replicate(eb, seq, pending_repl, st[2])
+        eb.send(client, user_kind(_H_COMMIT), (st[0],), when=pending_commit)
+        eb.after(
+            retx_ns, user_kind(_H_RETX), PRIMARY, (seq,),
+            when=pending_repl | pending_commit,
+        )
         return ctx.state, eb.build()
+
+    def on_cretx(ctx):
+        # client progress guard: re-send the write (or FIN) it is waiting
+        # on — covers lost WRITEs/FINs outright
+        st = ctx.state
+        waiting = st[0] < jnp.int32(writes)
+        eb = ctx.emits()
+        eb.send(
+            PRIMARY, user_kind(_H_WRITE), (st[0] + 1,), when=waiting
+        )
+        eb.send(PRIMARY, user_kind(_H_FIN), (), when=~waiting)
+        eb.after(client_retx_ns, user_kind(_H_CRETX), client)
+        return ctx.state, eb.build()
+
+    def on_fin(ctx):
+        st = ctx.state
+        new = st.at[3].set(1)
+        eb = ctx.emits()
+        _maybe_halt(eb, st[0], st[2], jnp.int32(1))
+        return new, eb.build()
+
+    def on_jretx(ctx):
+        st = ctx.state
+        behind = st[0] == 0
+        eb = ctx.emits()
+        eb.send(PRIMARY, user_kind(_H_JOIN), (ctx.node,), when=behind)
+        eb.after(retx_ns, user_kind(_H_JRETX), ctx.node, when=behind)
+        return ctx.state, eb.build()
+
+    def on_join(ctx):
+        # a replica (re)joined with empty state: clear its ack bit so the
+        # retx loop re-replicates the current write to it
+        who = ctx.args[0]
+        st = ctx.state
+        bit = jnp.int32(1) << (who - 1)
+        mask = st[2] & ~bit
+        new = st.at[2].set(mask)
+        eb = ctx.emits()
+        # the retx timer may have died while the mask was full: re-arm
+        eb.after(
+            retx_ns, user_kind(_H_RETX), PRIMARY, (st[1],), when=st[1] > 0
+        )
+        return new, eb.build()
 
     return Workload(
         name="kvchaos",
         n_nodes=n,
         state_width=4,
-        handlers=(on_init, on_write, on_repl, on_ack, on_commit, on_retx),
-        max_emits=n_replicas + 2,
+        handlers=(
+            on_init, on_write, on_repl, on_ack, on_commit, on_retx,
+            on_cretx, on_fin, on_join, on_jretx,
+        ),
+        # on_init builds up to 5 rows (write/cretx + join/jretx + 2 chaos);
+        # on_retx builds n_replicas+2
+        max_emits=max(n_replicas + 2, 6),
     )

@@ -110,6 +110,8 @@ class NativeEndpoint:
     async def send_to(self, dst, tag: int, payload: Any) -> None:
         if self._closed:
             raise ConnectionError("endpoint is closed")
+        if tag >= (1 << 64) - 1 or tag < 0:
+            raise ValueError("tag 2**64-1 is reserved for the handshake")
         if isinstance(dst, tuple):
             ip, port = dst[0], int(dst[1])
         else:
@@ -132,6 +134,8 @@ class NativeEndpoint:
 
         m = await loop.run_in_executor(self._pool, blocking)
         if not m:
+            if self._closed:
+                raise ConnectionError("endpoint closed during receive")
             raise asyncio.TimeoutError(f"recv tag {tag} timed out")
         try:
             n = lib.msep_msg_len(m)

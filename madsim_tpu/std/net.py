@@ -207,6 +207,8 @@ class Endpoint:
 
     # ---- tag-matching datagram surface ----------------------------------
     async def send_to(self, dst, tag: int, payload: Any) -> None:
+        if tag >= _HELLO_TAG or tag < 0:
+            raise ValueError("tag 2**64-1 is reserved for the handshake")
         writer = await self._writer_for(_parse(dst))
         writer.write(self._frame(tag, pickle.dumps(payload)))
         await writer.drain()

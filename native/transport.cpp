@@ -26,6 +26,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cerrno>
+
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -192,7 +194,8 @@ struct Endpoint {
           }
           continue;
         }
-        ssize_t r = ::recv(fd, tmp.data(), tmp.size(), 0);
+        ssize_t r = ::recv(fd, tmp.data(), tmp.size(), MSG_DONTWAIT);
+        if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
         std::lock_guard<std::mutex> g(mu);
         auto it = conns.find(fd);
         if (it == conns.end()) continue;
@@ -290,7 +293,13 @@ struct Endpoint {
                                  : connect_peer_locked(ip, pport, key);
     if (fd < 0) return -1;
     if (!send_frame(fd, tag, data, len)) {
-      drop_conn_locked(fd);
+      // only the epoll thread close()s connection fds (it may be about
+      // to recv() on this fd; closing here could let the fd number be
+      // reused mid-recv). shutdown() makes its recv return 0 so it
+      // performs the close safely on its own thread.
+      ::shutdown(fd, SHUT_RDWR);
+      auto pit = peers.find(key);
+      if (pit != peers.end() && pit->second == fd) peers.erase(pit);
       return -1;
     }
     return 0;

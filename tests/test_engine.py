@@ -371,3 +371,42 @@ class TestRunWhileAndCheckpoint:
         save_checkpoint(path, st, cfg)
         with pytest.raises(ValueError, match="different EngineConfig"):
             load_checkpoint(path, EngineConfig(pool_size=8, loss_p=0.5))
+
+
+class TestKvChaos:
+    def test_kvchaos_durability_invariant_under_crash(self):
+        """Config-5 shape: replicated KV with kill/restart chaos — every
+        seed completes and the final committed write is durable on every
+        replica at halt (re-sync after restart included)."""
+        from madsim_tpu.engine import make_run_while
+        from madsim_tpu.models import make_kvchaos
+
+        wl = make_kvchaos(writes=10)
+        cfg = EngineConfig(pool_size=160, loss_p=0.05)
+        init = make_init(wl, cfg)
+        out = jax.jit(make_run_while(wl, cfg, 8000))(
+            init(np.arange(64, dtype=np.uint64))
+        )
+        h = np.asarray(out.halted)
+        assert h.all()
+        ns = np.asarray(out.node_state)
+        assert (ns[:, -1, 0] == 10).all(), "client saw all commits"
+        durable = (ns[:, 1:5, 0] >= 10).sum(axis=1)
+        # RAM-only replicas: one crash can erase at most one post-ack
+        # copy, so >= R-1 always; the rejoin/re-sync path makes full
+        # durability the norm (exact on this fixed seed set)
+        assert (durable >= 3).all(), "durability floor violated"
+        assert (durable == 4).mean() >= 0.9
+        assert (np.asarray(out.overflow) == 0).all()
+
+    def test_kvchaos_deterministic(self):
+        from madsim_tpu.engine import make_run_while
+        from madsim_tpu.models import make_kvchaos
+
+        wl = make_kvchaos(writes=5)
+        cfg = EngineConfig(pool_size=160, loss_p=0.05)
+        init = make_init(wl, cfg)
+        run = jax.jit(make_run_while(wl, cfg, 4000))
+        a = run(init(np.arange(8, dtype=np.uint64)))
+        b = run(init(np.arange(8, dtype=np.uint64)))
+        assert np.array_equal(np.asarray(a.trace), np.asarray(b.trace))

@@ -217,6 +217,12 @@ class EmitBuilder:
     def restart_after(self, delay_ns, node, when=True):
         self.after(delay_ns, KIND_RESTART, 0, (node,), when)
 
+    def pause(self, node, when=True):
+        self.after(0, KIND_PAUSE, 0, (node,), when)
+
+    def resume(self, node, when=True):
+        self.after(0, KIND_RESUME, 0, (node,), when)
+
     def clog_link(self, a, b, when=True):
         self.after(0, KIND_CLOG, 0, (a, b), when)
 

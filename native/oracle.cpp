@@ -86,7 +86,9 @@ constexpr int32_t KIND_CLOG_NODE = 4;
 constexpr int32_t KIND_UNCLOG_NODE = 5;
 constexpr int32_t KIND_HALT = 6;
 constexpr int32_t KIND_NOP = 7;
-constexpr int32_t FIRST_USER_KIND = 8;
+constexpr int32_t KIND_PAUSE = 8;
+constexpr int32_t KIND_RESUME = 9;
+constexpr int32_t FIRST_USER_KIND = 10;
 
 constexpr int64_t kInf = int64_t{1} << 62;
 constexpr uint64_t kTracePrime = 0x100000001B3ull;
@@ -121,6 +123,7 @@ struct Effects {
   std::vector<Emit> emits;
   int32_t kill = -1, restart = -1;
   int32_t clog_a = -1, clog_b = -1, clog_set = -1;
+  int32_t pause_node = -1, pause_set = -1;
   bool halt = false;
 };
 
@@ -155,6 +158,7 @@ struct Sim {
   int64_t msg_count = 0;
   std::vector<Event> ev;
   std::vector<uint8_t> alive;
+  std::vector<uint8_t> paused;
   std::vector<int32_t> epoch;
   std::vector<int32_t> node_state;  // (N,U)
   std::vector<uint8_t> clog;        // (N,N)
@@ -165,6 +169,7 @@ struct Sim {
       ev[n] = Event{0, true, FIRST_USER_KIND, n, -1, 0, 0, {0, 0, 0, 0}};
     }
     alive.assign(wl.n_nodes, 1);
+    paused.assign(wl.n_nodes, 0);
     epoch.assign(wl.n_nodes, 0);
     node_state.assign(static_cast<size_t>(wl.n_nodes) * wl.state_width, 0);
     clog.assign(static_cast<size_t>(wl.n_nodes) * wl.n_nodes, 0);
@@ -207,7 +212,10 @@ struct Sim {
     bool live = alive[dst] && epoch[dst] == ev[i].epoch;
     bool clogged =
         is_msg && clog[static_cast<size_t>(src < 0 ? 0 : src) * wl.n_nodes + dst];
-    bool dispatch = active && !clogged && (is_engine || live);
+    // paused node: user events are stashed and retried (engine `held`)
+    bool held = !is_engine && paused[dst];
+    bool blocked = clogged || held;
+    bool dispatch = active && !blocked && (is_engine || live);
 
     if (active) now = ev_t;
     Draw draw{static_cast<uint32_t>(seed & 0xFFFFFFFFull),
@@ -221,7 +229,7 @@ struct Sim {
     int64_t backoff = cfg.clog_backoff_min_ns << shift;
     if (backoff > cfg.clog_backoff_max_ns) backoff = cfg.clog_backoff_max_ns;
     backoff += draw.uniform_int(0, 1000, kPurposeClogJitter);
-    bool resched = active && clogged;
+    bool resched = active && blocked && (is_engine || live);
     ev[i].valid = resched;
     if (resched) {
       ev[i].time = now + backoff;
@@ -256,6 +264,8 @@ struct Sim {
         case KIND_CLOG_NODE: eff.clog_a = args[0]; eff.clog_b = -1; eff.clog_set = 1; break;
         case KIND_UNCLOG_NODE: eff.clog_a = args[0]; eff.clog_b = -1; eff.clog_set = 0; break;
         case KIND_HALT: eff.halt = true; break;
+        case KIND_PAUSE: eff.pause_node = args[0]; eff.pause_set = 1; break;
+        case KIND_RESUME: eff.pause_node = args[0]; eff.pause_set = 0; break;
         default: break;  // NOP
       }
     }
@@ -279,6 +289,12 @@ struct Sim {
       for (int32_t u = 0; u < wl.state_width; u++)
         node_state[static_cast<size_t>(restart_id) * wl.state_width + u] = 0;
     }
+    int32_t pause_id = dispatch ? eff.pause_node : -1;
+    if (pause_id >= 0 && pause_id < wl.n_nodes)
+      paused[pause_id] = eff.pause_set == 1;
+    // kill/restart clears paused (fresh incarnation runs)
+    if (kill_id >= 0 && kill_id < wl.n_nodes) paused[kill_id] = 0;
+    if (restart_id >= 0 && restart_id < wl.n_nodes) paused[restart_id] = 0;
     int32_t clog_set = dispatch ? eff.clog_set : -1;
     if (clog_set >= 0) {
       for (int32_t a = 0; a < wl.n_nodes; a++) {

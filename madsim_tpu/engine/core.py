@@ -139,6 +139,21 @@ class EngineConfig:
     clog_backoff_max_ns: int = 10_000_000_000  # 1 ms -> 10 s (net/mod.rs:341-355)
     time_limit_ns: int = 0  # 0 = unlimited (set_time_limit, runtime/mod.rs:143)
 
+    def __post_init__(self):
+        # draws are 32-bit; a span that doesn't fit uint32 would silently
+        # wrap in the modulo reduction and skew the distribution
+        for lo, hi, what in (
+            (self.lat_min_ns, self.lat_max_ns, "latency"),
+            (self.proc_min_ns, self.proc_max_ns, "processing-cost"),
+        ):
+            if hi < lo:
+                raise ValueError(f"{what} range [{lo}, {hi}) is empty")
+            if hi - lo >= (1 << 32):
+                raise ValueError(
+                    f"{what} span {hi - lo} ns does not fit uint32 "
+                    f"(max {(1 << 32) - 1} ns, ~4.29 s)"
+                )
+
     @property
     def loss_u32(self) -> int:
         return chance_threshold(self.loss_p)
@@ -291,6 +306,18 @@ class Workload:
     handlers: tuple  # tuple[Handler, ...]
     max_emits: int = 8
     init_state: np.ndarray | None = None  # (N,U) int32; zeros if None
+
+    def __post_init__(self):
+        # emit slot s draws under PURPOSE_LATENCY(8)+s and
+        # PURPOSE_LOSS(64)+s; more than 56 slots would alias the two
+        # namespaces (and >64 would bleed into PURPOSE_USER), silently
+        # correlating "independent" draws
+        limit = PURPOSE_LOSS - PURPOSE_LATENCY
+        if self.max_emits > limit:
+            raise ValueError(
+                f"max_emits={self.max_emits} exceeds the purpose-namespace "
+                f"limit of {limit} (engine/rng.py purpose layout)"
+            )
 
     def initial_state(self) -> np.ndarray:
         if self.init_state is not None:
@@ -654,7 +681,12 @@ def make_step(wl: Workload, cfg: EngineConfig):
         loss_bits = jax.vmap(lambda s: draw.bits(jnp.uint32(PURPOSE_LOSS) + s))(slot_ix)
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
         latency = jnp.int64(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int64)
-        lost = em.send & (loss_bits < jnp.uint32(loss_u32))
+        # loss_u32 == 2^32 is the static always-drop path (loss_p=1.0);
+        # a uint32 compare can't express it (chance_threshold contract)
+        if loss_u32 >= (1 << 32):
+            lost = em.send
+        else:
+            lost = em.send & (loss_bits < jnp.uint32(loss_u32))
 
         e_valid = dispatch & em.valid & ~lost
         # sends to dead nodes are dropped at send time (socket gone,

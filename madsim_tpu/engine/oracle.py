@@ -45,7 +45,7 @@ def load() -> ctypes.CDLL:
         lib.oracle_run.argtypes = [
             ctypes.c_int32, ctypes.c_uint64, ctypes.c_int64,  # wl, seed, steps
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # pool, lat lo/hi
-            ctypes.c_uint32, ctypes.c_int64, ctypes.c_int64,  # loss, proc lo/hi
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,  # loss, proc lo/hi
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # backoff lo/hi, limit
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
@@ -114,7 +114,7 @@ def run_oracle(
         ctypes.c_int64(cfg.pool_size),
         ctypes.c_int64(cfg.lat_min_ns),
         ctypes.c_int64(cfg.lat_max_ns),
-        ctypes.c_uint32(cfg.loss_u32),
+        ctypes.c_uint64(cfg.loss_u32),
         ctypes.c_int64(cfg.proc_min_ns),
         ctypes.c_int64(cfg.proc_max_ns),
         ctypes.c_int64(cfg.clog_backoff_min_ns),

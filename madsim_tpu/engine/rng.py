@@ -160,8 +160,13 @@ class Draw:
 
         ``threshold_u32 = int(p * 2**32)`` is computed statically in
         Python so the comparison itself is pure uint32 — no float
-        rounding can diverge between backends.
+        rounding can diverge between backends. A static threshold of
+        2^32 (``chance_threshold(1.0)``) is the guaranteed-true path —
+        a uint32 compare alone can never return True for the draw
+        0xFFFFFFFF.
         """
+        if isinstance(threshold_u32, int) and threshold_u32 >= (1 << 32):
+            return jnp.bool_(True)
         return self.bits(purpose) < jnp.uint32(threshold_u32)
 
     def user(self, purpose):
@@ -174,9 +179,13 @@ class Draw:
 
 
 def chance_threshold(p: float) -> int:
-    """Static helper: probability -> uint32 threshold for :meth:`Draw.chance`."""
+    """Static helper: probability -> threshold for :meth:`Draw.chance`.
+
+    Returns a value in [0, 2^32]; 2^32 means "always true" (p=1.0 must
+    drop every packet, not 2^32-1 out of 2^32 of them).
+    """
     if p <= 0.0:
         return 0
     if p >= 1.0:
-        return (1 << 32) - 1
+        return 1 << 32
     return int(p * (1 << 32))

@@ -12,6 +12,7 @@ from .rpc import add_rpc_handler, add_rpc_handler_with_data, call, call_with_dat
 from .service import service
 from .tcp import TcpListener, TcpStream
 from .udp import UdpSocket
+from .unix import UnixDatagram, UnixListener, UnixStream
 
 if NetSim not in DEFAULT_SIMULATORS:
     DEFAULT_SIMULATORS.append(NetSim)
@@ -22,6 +23,9 @@ __all__ = [
     "TcpListener",
     "TcpStream",
     "UdpSocket",
+    "UnixDatagram",
+    "UnixListener",
+    "UnixStream",
     "Network",
     "PipeReceiver",
     "PipeSender",

@@ -136,6 +136,9 @@ class NetSim(Simulator):
         # pipes registered per node id — closed when the node resets,
         # deregistered when they close (no growth across connection churn)
         self._pipes_by_node: dict[int, set[Pipe]] = {}
+        # unix-domain socket namespace: (node_id, path) -> bound socket.
+        # Node-local IPC (paths never cross machines), wiped on reset.
+        self.unix_binds: dict[tuple[int, str], object] = {}
 
     # ---- Simulator lifecycle -------------------------------------------
     def create_node(self, node_id: int) -> None:
@@ -147,6 +150,11 @@ class NetSim(Simulator):
         for pipe in list(self._pipes_by_node.get(node_id, ())):
             pipe.close()
         self._pipes_by_node.pop(node_id, None)
+        for key in [k for k in self.unix_binds if k[0] == node_id]:
+            sock = self.unix_binds.pop(key)
+            on_reset = getattr(sock, "_on_node_reset", None)
+            if on_reset is not None:
+                on_reset()
 
     # ---- stats / chaos (mod.rs:126-216) --------------------------------
     @property

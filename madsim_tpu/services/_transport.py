@@ -17,7 +17,63 @@ from ..net.addr import AddrLike
 from ..net.endpoint import Endpoint
 from ..runtime.task import spawn
 
-__all__ = ["RequestClient", "serve_requests"]
+__all__ = ["RequestClient", "ResponseStream", "StreamReply", "serve_requests"]
+
+
+class StreamReply:
+    """Wrap an async generator to stream a response item-per-message.
+
+    A handler returning ``StreamReply(gen)`` keeps its connection open;
+    each yielded item travels as one message until the generator ends or
+    the client hangs up (the server-streaming shape of observe/watch
+    style ops — the reference's tonic server-streaming analog).
+    """
+
+    __slots__ = ("gen",)
+
+    def __init__(self, gen):
+        self.gen = gen
+
+
+class ResponseStream:
+    """Client half of a streamed reply: ``async for`` or ``message()``."""
+
+    def __init__(self, tx, rx, transport_error):
+        self._tx = tx
+        self._rx = rx
+        self._err = transport_error
+        self._done = False
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        item = await self.message()
+        if item is None:
+            raise StopAsyncIteration
+        return item
+
+    async def message(self) -> Any | None:
+        """Next item, or None when the stream ends (etcd-client shape)."""
+        if self._done:
+            return None
+        reply = await self._rx.recv()
+        if reply is None:
+            self.close()
+            return None
+        status, payload = reply
+        if status == "item":
+            return payload
+        self.close()
+        if status == "err":
+            raise payload
+        return None  # "end"
+
+    def close(self) -> None:
+        """Cancel the stream; the server's next send fails and its
+        generator unwinds."""
+        self._done = True
+        self._tx.close()
 
 
 class RequestClient:
@@ -52,6 +108,27 @@ class RequestClient:
             raise payload
         return payload
 
+    async def call_stream(self, op: str, **kwargs: Any) -> ResponseStream:
+        """Open a server-streaming op; the connection stays up for the
+        stream's lifetime (close the returned stream to cancel)."""
+        try:
+            tx, rx = await self._ep.connect1(self._dst)
+            await tx.send((op, kwargs))
+            first = await rx.recv()
+        except (ConnectionError, OSError) as e:
+            raise self._err(str(e)) from e
+        if first is None:
+            tx.close()
+            raise self._err("connection reset")
+        status, payload = first
+        if status == "err":
+            tx.close()
+            raise payload
+        if status != "ok-stream":
+            tx.close()
+            raise self._err(f"expected a stream, got {status!r}")
+        return ResponseStream(tx, rx, self._err)
+
 
 async def serve_requests(
     addr: AddrLike,
@@ -77,13 +154,28 @@ async def _serve_one(tx, rx, handler, error_type) -> None:
         op, kwargs = req
         try:
             result = await handler(op, kwargs)
-            await tx.send(("ok", result))
+            if isinstance(result, StreamReply):
+                await tx.send(("ok-stream", None))
+                try:
+                    async for item in result.gen:
+                        await tx.send(("item", item))
+                    await tx.send(("end", None))
+                finally:
+                    try:
+                        await result.gen.aclose()
+                    except RuntimeError:
+                        # task teardown delivered GeneratorExit while the
+                        # generator was suspended under this very frame;
+                        # it is already unwinding
+                        pass
+            else:
+                await tx.send(("ok", result))
         except error_type as e:
             try:
                 await tx.send(("err", e))
             except ConnectionError:
                 pass
         except ConnectionError:
-            pass
+            pass  # client hung up mid-stream: normal cancellation
     finally:
         tx.shutdown()

@@ -31,7 +31,7 @@ from ..runtime.rand import thread_rng
 from ..runtime.task import spawn
 from ..runtime.time_ import sleep
 from ..sync import Notify
-from ._transport import RequestClient, serve_requests
+from ._transport import RequestClient, ResponseStream, StreamReply, serve_requests
 
 __all__ = [
     "EtcdError",
@@ -458,6 +458,8 @@ class SimServer:
             while True:
                 win = inner.try_campaign(name, value, lease)
                 if win is not None:
+                    # a new leader exists: observers must hear about it
+                    self._election_notify.notify_waiters()
                     return win
                 if lease and lease not in inner.leases:
                     raise EtcdError("LeaseError", f"lease {lease} expired")
@@ -468,6 +470,7 @@ class SimServer:
             if kv is None:
                 raise EtcdError("ElectError", "session expired / not leader")
             inner.put(key, value, PutOptions(lease=kv.lease))
+            self._election_notify.notify_waiters()
             return {"header_revision": inner.revision}
         if op == "leader":
             kv = inner.leader_kv(kw["name"])
@@ -481,9 +484,24 @@ class SimServer:
                 self._election_notify.notify_waiters()
             return {"header_revision": inner.revision}
         if op == "observe":
-            # parity: unimplemented on the reference server (server.rs:60)
-            raise EtcdError("Unimplemented", "observe")
+            # leader-change stream — the reference server left this
+            # unimplemented (madsim-etcd-client/src/server.rs:60); real
+            # etcd semantics: report the current leader, then every
+            # change, with rapid flaps allowed to coalesce
+            return StreamReply(self._observe(kw["name"]))
         raise EtcdError("InvalidArgs", f"unknown op {op}")
+
+    async def _observe(self, name: bytes):
+        last = None
+        while True:
+            kv = self._inner.leader_kv(name)
+            if kv is not None and (kv.key, kv.mod_revision) != last:
+                last = (kv.key, kv.mod_revision)
+                yield {"kv": kv._copy()}
+                # re-check before parking: a change that landed while the
+                # yielded item was in flight must not wait for the next wake
+                continue
+            await self._election_notify.notified()
 
 
 # ---------------------------------------------------------------------------
@@ -616,5 +634,11 @@ class ElectionClient:
     async def resign(self, key) -> dict:
         return await self._raw.call("resign", key=_to_bytes(key))
 
-    async def observe(self, name):
-        return await self._raw.call("observe", name=_to_bytes(name))
+    async def observe(self, name) -> ResponseStream:
+        """Stream of leader changes for ``name``: the current leader
+        first, then every handover (campaign win, proclaim, resign,
+        lease expiry). Beats the reference — its server answers this
+        with Unimplemented (madsim-etcd-client/src/server.rs:60).
+        Iterate with ``async for`` or ``await stream.message()``;
+        ``stream.close()`` cancels."""
+        return await self._raw.call_stream("observe", name=_to_bytes(name))

@@ -225,6 +225,8 @@ class Endpoint:
         self, dst, req: Any, data: bytes, timeout: Optional[float] = None
     ) -> tuple[Any, bytes]:
         resp_tag = random.getrandbits(63) | (1 << 63)
+        while resp_tag == _HELLO_TAG:  # 2^64-1 is reserved for the handshake
+            resp_tag = random.getrandbits(63) | (1 << 63)
         await self.send_to(dst, rpc_id(type(req)), (req, data, resp_tag))
         try:
             if timeout is not None:

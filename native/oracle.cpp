@@ -97,7 +97,7 @@ constexpr uint64_t kTraceMix = 0x9E3779B97F4A7C15ull;
 struct Config {  // EngineConfig
   int64_t pool_size;
   int64_t lat_min_ns, lat_max_ns;
-  uint32_t loss_u32;
+  uint64_t loss_u32;  // in [0, 2^32]; 2^32 = always drop (loss_p=1.0)
   int64_t proc_min_ns, proc_max_ns;
   int64_t clog_backoff_min_ns, clog_backoff_max_ns;
   int64_t time_limit_ns;  // 0 = unlimited
@@ -327,7 +327,7 @@ struct Sim {
       uint32_t span = static_cast<uint32_t>(cfg.lat_max_ns - cfg.lat_min_ns);
       if (span == 0) span = 1;
       int64_t latency = cfg.lat_min_ns + static_cast<int64_t>(lat_bits % span);
-      bool lost = e.send && loss_bits < cfg.loss_u32;
+      bool lost = e.send && static_cast<uint64_t>(loss_bits) < cfg.loss_u32;
       bool e_valid = dispatch && e.valid && !lost;
       if (e.send && e_valid && !(e.dst >= 0 && e.dst < wl.n_nodes && alive[e.dst]))
         e_valid = false;
@@ -577,7 +577,7 @@ void oracle_set_raft(int32_t n_nodes, int64_t tmin, int64_t tmax) {
 // SimState fields the trace compare checks.
 int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
                    int64_t pool_size, int64_t lat_min_ns, int64_t lat_max_ns,
-                   uint32_t loss_u32, int64_t proc_min_ns, int64_t proc_max_ns,
+                   uint64_t loss_u32, int64_t proc_min_ns, int64_t proc_max_ns,
                    int64_t clog_backoff_min_ns, int64_t clog_backoff_max_ns,
                    int64_t time_limit_ns, int64_t* out_now, uint64_t* out_trace,
                    int64_t* out_msg_count, int32_t* out_halted,

@@ -6,8 +6,12 @@
 // canonical listening address, length-delimited frames, and a
 // tag-matching mailbox (reference madsim/src/std/net/tcp.rs:22-135,
 // C26). This is that component in C++: a background epoll thread per
-// endpoint reads frames into the mailbox; sends run on the caller
-// thread with blocking sockets.
+// endpoint reads frames into the mailbox; sends enqueue onto a
+// per-connection write buffer flushed with non-blocking writes (by the
+// caller when the socket has room, else by the epoll thread on
+// EPOLLOUT) — a send can never block while holding the endpoint lock,
+// so two in-process endpoints with full socket buffers cannot deadlock
+// each other's reader threads.
 //
 // Wire format (shared with the asyncio backend in madsim_tpu/std/net.py
 // so C++ and Python endpoints interoperate):
@@ -42,6 +46,9 @@ namespace {
 
 constexpr uint64_t kHelloTag = ~0ull;
 constexpr uint64_t kMaxFrame = 1ull << 30;  // 1 GiB sanity cap
+// backpressure bound: one max-size frame may always be queued; beyond
+// that do_send reports failure instead of buffering without limit
+constexpr size_t kMaxWbuf = (1ull << 30) + (1ull << 20);
 
 uint64_t load_be64(const uint8_t* p) {
   uint64_t v = 0;
@@ -66,24 +73,18 @@ struct Conn {
   int fd;
   std::string peer_key;  // canonical "ip:port" after hello, else ""
   std::vector<uint8_t> rbuf;
+  std::vector<uint8_t> wbuf;  // pending outbound bytes (framed)
+  size_t woff = 0;            // consumed prefix of wbuf
+  bool want_write = false;    // EPOLLOUT armed
 };
 
-bool send_all(int fd, const uint8_t* p, size_t n) {
-  while (n > 0) {
-    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
-    if (w <= 0) return false;
-    p += w;
-    n -= static_cast<size_t>(w);
-  }
-  return true;
-}
-
-bool send_frame(int fd, uint64_t tag, const uint8_t* data, uint64_t len) {
+void append_frame(std::vector<uint8_t>& out, uint64_t tag, const uint8_t* data,
+                  uint64_t len) {
   uint8_t head[16];
   store_be64(head, len);
   store_be64(head + 8, tag);
-  if (!send_all(fd, head, 16)) return false;
-  return len == 0 || send_all(fd, data, len);
+  out.insert(out.end(), head, head + 16);
+  if (len) out.insert(out.end(), data, data + len);
 }
 
 struct Endpoint {
@@ -160,6 +161,46 @@ struct Endpoint {
     epoll_ctl(epoll_fd, EPOLL_CTL_ADD, fd, &ev);
   }
 
+  void arm_write_locked(Conn& c, bool want) {
+    if (c.want_write == want) return;
+    c.want_write = want;
+    epoll_event ev{};
+    ev.events = EPOLLIN | (want ? static_cast<uint32_t>(EPOLLOUT) : 0u);
+    ev.data.fd = c.fd;
+    epoll_ctl(epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+
+  // Non-blocking drain of c.wbuf. Returns false on a fatal socket
+  // error (caller drops the conn). Never blocks: a full socket buffer
+  // just leaves the tail queued with EPOLLOUT armed.
+  bool flush_locked(Conn& c) {
+    while (c.woff < c.wbuf.size()) {
+      ssize_t w = ::send(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (w > 0) {
+        c.woff += static_cast<size_t>(w);
+        continue;
+      }
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        // reclaim the consumed prefix even while backpressured, or a
+        // connection that never fully drains retains every byte it
+        // ever sent
+        if (c.woff > (1u << 20)) {
+          c.wbuf.erase(c.wbuf.begin(),
+                       c.wbuf.begin() + static_cast<ptrdiff_t>(c.woff));
+          c.woff = 0;
+        }
+        arm_write_locked(c, true);
+        return true;
+      }
+      return false;
+    }
+    c.wbuf.clear();
+    c.woff = 0;
+    arm_write_locked(c, false);
+    return true;
+  }
+
   void drop_conn_locked(int fd) {
     epoll_ctl(epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
     auto it = conns.find(fd);
@@ -189,11 +230,20 @@ struct Endpoint {
           int cfd = accept4(listen_fd, nullptr, nullptr, SOCK_CLOEXEC);
           if (cfd >= 0) {
             std::lock_guard<std::mutex> g(mu);
-            conns[cfd] = Conn{cfd, "", {}};
+            conns[cfd] = Conn{cfd, "", {}, {}, 0, false};
             watch(cfd);
           }
           continue;
         }
+        if (events[i].events & EPOLLOUT) {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = conns.find(fd);
+          if (it != conns.end() && !flush_locked(it->second)) {
+            drop_conn_locked(fd);
+            continue;
+          }
+        }
+        if (!(events[i].events & (EPOLLIN | EPOLLERR | EPOLLHUP))) continue;
         ssize_t r = ::recv(fd, tmp.data(), tmp.size(), MSG_DONTWAIT);
         if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
         std::lock_guard<std::mutex> g(mu);
@@ -265,26 +315,32 @@ struct Endpoint {
       my_ip = buf;
     }
     std::string hello = my_ip + ":" + std::to_string(port);
-    if (!send_frame(fd, kHelloTag,
-                    reinterpret_cast<const uint8_t*>(hello.data()),
-                    hello.size())) {
-      ::close(fd);
-      return -1;
-    }
-    conns[fd] = Conn{fd, key, {}};
+    Conn c{fd, key, {}, {}, 0, false};
+    append_frame(c.wbuf, kHelloTag,
+                 reinterpret_cast<const uint8_t*>(hello.data()), hello.size());
+    conns[fd] = std::move(c);
     peers[key] = fd;
     watch(fd);
+    if (!flush_locked(conns[fd])) {
+      // same rule as do_send's failure path: only the epoll thread may
+      // close() a watched fd (it may already hold an event for it);
+      // shutdown makes its recv return 0 so it closes safely itself
+      ::shutdown(fd, SHUT_RDWR);
+      peers.erase(key);
+      return -1;
+    }
     return fd;
   }
 
   int do_send(const char* ip, int pport, uint64_t tag, const uint8_t* data,
               uint64_t len) {
-    // The whole send (lookup + connect + frame write) holds mu: the
-    // epoll thread closes fds under the same lock, so a send can never
-    // write into a closed-and-reused descriptor, and concurrent sends
-    // to one peer cannot interleave their frames. Trade-off: a send
-    // blocked on a full socket buffer stalls this endpoint's reads —
-    // acceptable for the v1 transport (message sizes are modest).
+    // Lookup + connect + enqueue hold mu (the epoll thread closes fds
+    // under the same lock, so a send can never target a
+    // closed-and-reused descriptor, and concurrent sends to one peer
+    // cannot interleave frames) — but the socket write itself is
+    // non-blocking: a full socket buffer leaves the tail queued for the
+    // epoll thread's EPOLLOUT flush instead of stalling reads, so two
+    // in-process endpoints saturating each other cannot deadlock.
     std::string key = std::string(ip) + ":" + std::to_string(pport);
     std::lock_guard<std::mutex> g(mu);
     if (closed) return -1;
@@ -292,7 +348,12 @@ struct Endpoint {
     int fd = (it != peers.end()) ? it->second
                                  : connect_peer_locked(ip, pport, key);
     if (fd < 0) return -1;
-    if (!send_frame(fd, tag, data, len)) {
+    auto cit = conns.find(fd);
+    if (cit == conns.end()) return -1;
+    Conn& c = cit->second;
+    if (c.wbuf.size() - c.woff + len + 16 > kMaxWbuf) return -1;  // backpressure
+    append_frame(c.wbuf, tag, data, len);
+    if (!flush_locked(c)) {
       // only the epoll thread close()s connection fds (it may be about
       // to recv() on this fd; closing here could let the fd number be
       // reused mid-recv). shutdown() makes its recv return 0 so it

@@ -378,6 +378,54 @@ def test_etcd_election_campaign_resign():
     assert run(13, main)
 
 
+def test_etcd_election_observe():
+    """observe streams campaign -> proclaim -> resign -> handover; the
+    reference server answers this op with Unimplemented (server.rs:60)."""
+
+    async def main():
+        h = ms.Handle.current()
+        addr = _spawn_etcd(h)
+        app_node = h.create_node().name("app").ip("10.0.2.2").build()
+
+        async def app():
+            await ms.sleep(0.1)
+            c1 = await etcd.Client.connect([addr])
+            c2 = await etcd.Client.connect([addr])
+            obs_cli = await etcd.Client.connect([addr])
+            l1 = await c1.lease_client().grant(ttl=60)
+            l2 = await c2.lease_client().grant(ttl=60)
+            e1 = c1.election_client()
+            e2 = c2.election_client()
+
+            stream = await obs_cli.election_client().observe("mayor")
+            seen = []
+
+            async def observer():
+                async for resp in stream:
+                    seen.append(resp["kv"].value)
+
+            obs_task = ms.spawn(observer())
+
+            win1 = await e1.campaign("mayor", "alice", l1["id"])
+            await ms.sleep(0.5)
+            await e1.proclaim(win1["key"], "alice2")
+            await ms.sleep(0.5)
+            second = ms.spawn(e2.campaign("mayor", "bob", l2["id"]))
+            await ms.sleep(0.5)
+            await e1.resign(win1["key"])
+            await second
+            await ms.sleep(0.5)
+            assert seen == [b"alice", b"alice2", b"bob"]
+            stream.close()
+            await ms.sleep(0.5)
+            assert obs_task.done()
+            return True
+
+        return await app_node.spawn(app())
+
+    assert run(15, main)
+
+
 def test_etcd_election_lease_expiry_hands_over():
     async def main():
         h = ms.Handle.current()

@@ -3,12 +3,15 @@
 The reference defines (but never records) two workloads on its *std*
 runtime (madsim/benches/rpc.rs:11-55): empty-RPC latency and RPC
 throughput with 16 B - 1 MiB payloads over real TCP loopback. Same
-workloads here on the std backend:
+workloads here on the std backend, then a transport-level comparison of
+the native endpoints — C++ epoll TCP (C26) vs the shared-memory fast
+path (the UCX/eRPC role, C27/C28):
 
     python examples/rpc_bench.py
 """
 
 import asyncio
+import ctypes
 import sys
 import time
 
@@ -67,5 +70,70 @@ async def main():
     await client.close()
 
 
+def _raw(mod, prefix):
+    lib = mod._load()
+    return (
+        getattr(lib, prefix + "bind"),
+        getattr(lib, prefix + "send"),
+        getattr(lib, prefix + "recv"),
+        getattr(lib, prefix + "msg_free"),
+        getattr(lib, prefix + "shutdown"),
+        getattr(lib, prefix + "free"),
+    )
+
+
+def native_transport_bench():
+    """Head-to-head: epoll TCP endpoint vs shm ring, C ABI level."""
+    try:
+        from madsim_tpu.std import fastpath
+        from madsim_tpu.std import native as native_mod
+    except Exception as e:  # toolchain missing
+        print(f"(native transports unavailable: {e})")
+        return
+    if not (native_mod.available() and fastpath.available()):
+        print("(native toolchain unavailable; skipping transport bench)")
+        return
+    for label, mod, prefix in (
+        ("epoll-tcp", native_mod, "msep_"),
+        ("shm-ring ", fastpath, "shmep_"),
+    ):
+        bind, send, recv, free, shutdown, dealloc = _raw(mod, prefix)
+        pa, pb = ctypes.c_int(0), ctypes.c_int(0)
+        a = bind(b"127.0.0.1", 0, ctypes.byref(pa))
+        b = bind(b"127.0.0.1", 0, ctypes.byref(pb))
+        try:
+            send(a, b"127.0.0.1", pb.value, 1, b"x", 1)
+            free(recv(b, 1, 5000))
+            n = 2000
+            t0 = time.perf_counter()
+            for _ in range(n):
+                send(a, b"127.0.0.1", pb.value, 1, b"x", 1)
+                free(recv(b, 1, 5000))
+                send(b, b"127.0.0.1", pa.value, 2, b"y", 1)
+                free(recv(a, 2, 5000))
+            rtt = (time.perf_counter() - t0) / n
+            blob = b"z" * 65536
+            reps = 2000
+            t0 = time.perf_counter()
+            sent = received = 0
+            while received < reps:
+                while sent < reps and sent - received < 32:
+                    send(a, b"127.0.0.1", pb.value, 3, blob, len(blob))
+                    sent += 1
+                free(recv(b, 3, 10000))
+                received += 1
+            dt = time.perf_counter() - t0
+            print(
+                f"{label}: rtt {rtt * 1e6:>6.1f} us   "
+                f"64KiB one-way {len(blob) * reps / dt / 1e9:>5.2f} GB/s"
+            )
+        finally:
+            shutdown(a)
+            shutdown(b)
+            dealloc(a)
+            dealloc(b)
+
+
 if __name__ == "__main__":
     asyncio.run(main())
+    native_transport_bench()

@@ -182,9 +182,10 @@ class Emits:
     dst: jnp.ndarray  # (K,)  int32
     delay: jnp.ndarray  # (K,)  int64 ns (timer) / ignored for sends
     args: jnp.ndarray  # (K,4) int32
+    pay: jnp.ndarray  # (K,W) int32 payload words (W = Workload.payload_words)
 
     @staticmethod
-    def none(k: int) -> "Emits":
+    def none(k: int, w: int = 0) -> "Emits":
         return Emits(
             valid=jnp.zeros((k,), jnp.bool_),
             send=jnp.zeros((k,), jnp.bool_),
@@ -192,6 +193,7 @@ class Emits:
             dst=jnp.zeros((k,), jnp.int32),
             delay=jnp.zeros((k,), jnp.int64),
             args=jnp.zeros((k, 4), jnp.int32),
+            pay=jnp.zeros((k, w), jnp.int32),
         )
 
 
@@ -202,26 +204,36 @@ class EmitBuilder:
     flag is the traced per-seed condition making an emit conditional.
     """
 
-    def __init__(self, k: int):
+    def __init__(self, k: int, w: int = 0):
         self._k = k
+        self._w = w
         self._rows: list[tuple] = []
 
-    def _push(self, send, kind, dst, delay, args, when):
+    def _push(self, send, kind, dst, delay, args, when, pay=()):
         if len(self._rows) >= self._k:
             raise ValueError(
                 f"handler emits more than max_emits={self._k} events; "
                 f"raise Workload.max_emits"
             )
         a = list(args) + [0] * (4 - len(args))
-        self._rows.append((when, send, kind, dst, delay, a))
+        p = list(pay)
+        if len(p) > self._w:
+            raise ValueError(
+                f"payload of {len(p)} words exceeds "
+                f"Workload.payload_words={self._w}"
+            )
+        self._rows.append((when, send, kind, dst, delay, a, p))
 
-    def send(self, dst, kind, args=(), when=True):
-        """Send a network message: delivery after latency unless lost/clogged."""
-        self._push(True, kind, dst, 0, args, when)
+    def send(self, dst, kind, args=(), when=True, pay=()):
+        """Send a network message: delivery after latency unless lost/clogged.
+        ``pay`` is an optional payload of up to ``Workload.payload_words``
+        int32 words, carried with the event (the batched analog of the
+        reference's ``Payload = Box<dyn Any>``, sim/net/endpoint.rs:13-23)."""
+        self._push(True, kind, dst, 0, args, when, pay)
 
-    def after(self, delay_ns, kind, dst, args=(), when=True):
+    def after(self, delay_ns, kind, dst, args=(), when=True, pay=()):
         """Schedule a local event ``delay_ns`` in the future (a timer)."""
-        self._push(False, kind, dst, delay_ns, args, when)
+        self._push(False, kind, dst, delay_ns, args, when, pay)
 
     def kill(self, node, when=True):
         self.after(0, KIND_KILL, 0, (node,), when)
@@ -248,18 +260,27 @@ class EmitBuilder:
         self.after(0, KIND_HALT, 0, (), when)
 
     def build(self) -> Emits:
-        k = self._k
+        k, w = self._k, self._w
         if not self._rows:
-            return Emits.none(k)
+            return Emits.none(k, w)
         pad = k - len(self._rows)
-        valid = [jnp.asarray(w, jnp.bool_) for (w, *_r) in self._rows]
+        valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_r) in self._rows]
         send = [jnp.asarray(s, jnp.bool_) for (_w, s, *_r) in self._rows]
         kind = [jnp.asarray(kd, jnp.int32) for (_w, _s, kd, *_r) in self._rows]
-        dst = [jnp.asarray(d, jnp.int32) for (*_h, d, _dl, _a) in self._rows]
-        delay = [jnp.asarray(dl, jnp.int64) for (*_h, dl, _a) in self._rows]
+        dst = [jnp.asarray(d, jnp.int32) for (*_h, d, _dl, _a, _p) in self._rows]
+        delay = [jnp.asarray(dl, jnp.int64) for (*_h, dl, _a, _p) in self._rows]
         args = [
-            jnp.stack([jnp.asarray(x, jnp.int32) for x in a]) for (*_h, a) in self._rows
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in a])
+            for (*_h, a, _p) in self._rows
         ]
+
+        def pay_row(p: list) -> jnp.ndarray:
+            if not p:
+                return jnp.zeros((w,), jnp.int32)
+            row = jnp.stack([jnp.asarray(x, jnp.int32) for x in p])
+            return jnp.concatenate([row, jnp.zeros((w - len(p),), jnp.int32)])
+
+        pay = [pay_row(p) for (*_h, p) in self._rows]
         z32 = jnp.int32(0)
         return Emits(
             valid=jnp.stack(valid + [jnp.asarray(False)] * pad),
@@ -268,6 +289,7 @@ class EmitBuilder:
             dst=jnp.stack(dst + [z32] * pad),
             delay=jnp.stack(delay + [jnp.int64(0)] * pad),
             args=jnp.stack(args + [jnp.zeros((4,), jnp.int32)] * pad),
+            pay=jnp.stack(pay + [jnp.zeros((w,), jnp.int32)] * pad),
         )
 
 
@@ -282,9 +304,11 @@ class HandlerCtx:
     src: jnp.ndarray  # int32 — sender node for messages, -1 for timers
     draw: Draw  # counter-based RNG for this event
     max_emits: int
+    payload: jnp.ndarray = None  # (W,) int32 — the event's payload words
+    payload_words: int = 0
 
     def emits(self) -> EmitBuilder:
-        return EmitBuilder(self.max_emits)
+        return EmitBuilder(self.max_emits, self.payload_words)
 
 
 Handler = Callable[[HandlerCtx], tuple]
@@ -306,6 +330,11 @@ class Workload:
     handlers: tuple  # tuple[Handler, ...]
     max_emits: int = 8
     init_state: np.ndarray | None = None  # (N,U) int32; zeros if None
+    # payload arena width: int32 words carried by every event (0 = off).
+    # The batched analog of Payload = Box<dyn Any> (endpoint.rs:13-23):
+    # payload lifetime equals event lifetime, so the arena IS the event
+    # pool — no separate allocator, no leaks
+    payload_words: int = 0
 
     def __post_init__(self):
         # emit slot s draws under PURPOSE_LATENCY(8)+s and
@@ -347,6 +376,7 @@ class SimState:
     ev_epoch: jnp.ndarray  # (E,) int32 target-node epoch at emit time
     ev_retry: jnp.ndarray  # (E,) int32 clog-backoff retry count
     ev_args: jnp.ndarray  # (E,4) int32
+    ev_pay: jnp.ndarray  # (E,W) int32 payload words (W=0 when disabled)
     # nodes
     alive: jnp.ndarray  # (N,) bool
     paused: jnp.ndarray  # (N,) bool — events held while paused (pause/resume)
@@ -378,11 +408,11 @@ class _Effects:
     halt: jnp.ndarray  # bool
 
 
-def _no_effects(state_row: jnp.ndarray, k: int) -> _Effects:
+def _no_effects(state_row: jnp.ndarray, k: int, w: int = 0) -> _Effects:
     m1 = jnp.int32(-1)
     return _Effects(
         node_state=state_row,
-        emits=Emits.none(k),
+        emits=Emits.none(k, w),
         kill=m1,
         restart=m1,
         pause_node=m1,
@@ -409,6 +439,7 @@ def make_init(wl: Workload, cfg: EngineConfig):
     if e < n:
         raise ValueError(f"pool_size={e} must hold at least one event per node ({n})")
     del k
+    w = wl.payload_words
     base_state = jnp.asarray(wl.initial_state())
 
     def init_one(seed) -> SimState:
@@ -434,6 +465,7 @@ def make_init(wl: Workload, cfg: EngineConfig):
             ev_epoch=jnp.zeros((e,), jnp.int32),
             ev_retry=jnp.zeros((e,), jnp.int32),
             ev_args=jnp.zeros((e, 4), jnp.int32),
+            ev_pay=jnp.zeros((e, w), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
             paused=jnp.zeros((n,), jnp.bool_),
             epoch=jnp.zeros((n,), jnp.int32),
@@ -453,7 +485,7 @@ def make_init(wl: Workload, cfg: EngineConfig):
 # ---------------------------------------------------------------------------
 
 
-def _trace_fold(trace, now, kind, node, args):
+def _trace_fold(trace, now, kind, node, args, pay=None):
     """Fold one dispatched event into the rolling trace hash (uint64)."""
     h = now.astype(jnp.uint64) * _TRACE_MIX
     h = h ^ (kind.astype(jnp.uint64) << jnp.uint64(32))
@@ -462,6 +494,12 @@ def _trace_fold(trace, now, kind, node, args):
     h = h ^ a[0] ^ (a[1] << jnp.uint64(8)) ^ (a[2] << jnp.uint64(16)) ^ (
         a[3] << jnp.uint64(24)
     )
+    if pay is not None and pay.shape[0] > 0:
+        # payload words participate in the trace so a payload divergence
+        # between backends is caught; W=0 keeps pre-payload traces intact
+        p = pay.astype(jnp.uint32).astype(jnp.uint64)
+        idx = jnp.arange(p.shape[0], dtype=jnp.uint64)
+        h = h ^ jnp.sum(p * (_TRACE_MIX ^ idx))
     return trace * _TRACE_PRIME + h
 
 
@@ -475,6 +513,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
     """
     n = wl.n_nodes
     k = wl.max_emits
+    w = wl.payload_words
     init_rows = jnp.asarray(wl.initial_state())
     n_branches = FIRST_USER_KIND + len(wl.handlers)
 
@@ -482,7 +521,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
     # lax.switch operands must be pytrees, so the context travels as a
     # tuple of arrays and each branch rebuilds the HandlerCtx view.
     def _unpack(op) -> HandlerCtx:
-        now, node, state, args, src, k0, k1, stp = op
+        now, node, state, args, src, k0, k1, stp, pay = op
         return HandlerCtx(
             now=now,
             node=node,
@@ -491,12 +530,14 @@ def make_step(wl: Workload, cfg: EngineConfig):
             src=src,
             draw=Draw.from_parts(k0, k1, stp),
             max_emits=k,
+            payload=pay,
+            payload_words=w,
         )
 
     def _engine_branch(effect_fn):
         def branch(op):
             ctx = _unpack(op)
-            eff = _no_effects(ctx.state, k)
+            eff = _no_effects(ctx.state, k, w)
             return effect_fn(eff, ctx)
 
         return branch
@@ -507,7 +548,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
     def _b_restart(eff, ctx):
         # the reborn node re-runs its init handler — the stored-init-task
         # respawn of task.rs:279-291
-        eb = EmitBuilder(k)
+        eb = EmitBuilder(k, w)
         eb.after(0, FIRST_USER_KIND, ctx.args[0])
         return dataclasses.replace(eff, restart=ctx.args[0], emits=eb.build())
 
@@ -551,7 +592,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
         def branch(op):
             ctx = _unpack(op)
             new_state, emits = handler(ctx)
-            eff = _no_effects(ctx.state, k)
+            eff = _no_effects(ctx.state, k, w)
             return dataclasses.replace(
                 eff, node_state=jnp.asarray(new_state, jnp.int32), emits=emits
             )
@@ -625,7 +666,10 @@ def make_step(wl: Workload, cfg: EngineConfig):
 
         # ---- dispatch ----
         safe_kind = jnp.clip(kind, 0, n_branches - 1)
-        operand = (now, dst, st.node_state[dst], args, src, draw.k0, draw.k1, draw.step)
+        operand = (
+            now, dst, st.node_state[dst], args, src,
+            draw.k0, draw.k1, draw.step, st.ev_pay[i],
+        )
         eff = lax.switch(safe_kind, branches, operand)
 
         # ---- apply node-state update ----
@@ -722,10 +766,13 @@ def make_step(wl: Workload, cfg: EngineConfig):
         ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
         ev_retry = ev_retry.at[slot].set(jnp.zeros((k,), jnp.int32), mode="drop")
         ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
+        ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
 
         # ---- trace + clock ----
         trace = jnp.where(
-            dispatch, _trace_fold(st.trace, now, kind, dst, args), st.trace
+            dispatch,
+            _trace_fold(st.trace, now, kind, dst, args, st.ev_pay[i]),
+            st.trace,
         )
         return SimState(
             seed=st.seed,
@@ -744,6 +791,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
             ev_epoch=ev_epoch,
             ev_retry=ev_retry,
             ev_args=ev_args,
+            ev_pay=ev_pay,
             alive=alive,
             paused=paused,
             epoch=epoch,

@@ -48,6 +48,8 @@ PRIMARY = 0
 _P_KILL_AT = 0
 _P_KILL_WHO = 1
 _P_REVIVE = 2
+_P_VAL0 = 8
+_P_VAL1 = 9
 
 
 def make_kvchaos(
@@ -56,18 +58,39 @@ def make_kvchaos(
     retx_ns: int = 40_000_000,
     client_retx_ns: int = 100_000_000,
     chaos: bool = True,
+    payload: bool = False,
 ) -> Workload:
+    """``payload=True`` turns on the engine payload arena: each WRITE
+    carries two random int32 value words (drawn by the client, unknowable
+    to replicas except via the message), the primary stores and
+    re-replicates them, replicas store what they receive — real data
+    transported through the batched network, oracle-verified since the
+    payload words feed the trace hash.
+
+    Payload state layout (state_width 6):
+      Primary: [committed, inflight, mask, fin, v0, v1]
+      Replica: [applied_seq, applies, v0, v1, 0, 0]
+      Client:  [commits_seen, 0, 0, 0, 0, 0]
+    """
     n = 1 + n_replicas + 1
     client = n - 1
     replicas = list(range(1, 1 + n_replicas))
     majority = n_replicas // 2 + 1
     full_mask = (1 << n_replicas) - 1
+    width = 6 if payload else 4
 
-    def _replicate(eb, seq, when, mask):
+    def _client_value(ctx):
+        """Two fresh random words for an outgoing WRITE (payload mode)."""
+        v0 = ctx.draw.user(_P_VAL0).astype(jnp.int32)
+        v1 = ctx.draw.user(_P_VAL1).astype(jnp.int32)
+        return (v0, v1)
+
+    def _replicate(eb, seq, when, mask, pay=()):
         for i, r in enumerate(replicas):
             eb.send(
                 r, user_kind(_H_REPL), (seq,),
                 when=when & (((mask >> i) & 1) == 0),
+                pay=pay,
             )
 
     def on_init(ctx):
@@ -75,7 +98,10 @@ def make_kvchaos(
         is_client = ctx.node == jnp.int32(client)
         is_replica = (ctx.node >= 1) & (ctx.node <= jnp.int32(n_replicas))
         # client kicks off write 1 and its progress-retry timer
-        eb.send(PRIMARY, user_kind(_H_WRITE), (jnp.int32(1),), when=is_client)
+        eb.send(
+            PRIMARY, user_kind(_H_WRITE), (jnp.int32(1),),
+            when=is_client, pay=_client_value(ctx) if payload else (),
+        )
         eb.after(client_retx_ns, user_kind(_H_CRETX), client, when=is_client)
         # replicas announce themselves — at t=0 and again after restart,
         # which is how the primary learns to re-sync a reborn replica;
@@ -96,15 +122,31 @@ def make_kvchaos(
         st = ctx.state
         fresh = (seq > st[0]) & (seq > st[1])
         new = jnp.where(fresh, st.at[1].set(seq).at[2].set(0), st)
+        if payload:
+            # the first WRITE to arrive for a seq fixes its value; the
+            # primary stores it so retx re-sends the accepted value
+            new = jnp.where(
+                fresh,
+                new.at[4].set(ctx.payload[0]).at[5].set(ctx.payload[1]),
+                new,
+            )
         eb = ctx.emits()
-        _replicate(eb, seq, fresh, jnp.int32(0))
+        pay = (new[4], new[5]) if payload else ()
+        _replicate(eb, seq, fresh, jnp.int32(0), pay)
         eb.after(retx_ns, user_kind(_H_RETX), PRIMARY, (seq,), when=fresh)
         return new, eb.build()
 
     def on_repl(ctx):
         seq = ctx.args[0]
         st = ctx.state
+        fresh = seq > st[0]
         new = st.at[0].set(jnp.maximum(st[0], seq)).at[1].set(st[1] + 1)
+        if payload:
+            new = jnp.where(
+                fresh,
+                new.at[2].set(ctx.payload[0]).at[3].set(ctx.payload[1]),
+                new,
+            )
         eb = ctx.emits()
         eb.send(PRIMARY, user_kind(_H_ACK), (seq, ctx.node))
         return new, eb.build()
@@ -143,7 +185,10 @@ def make_kvchaos(
         new = jnp.where(fresh, st.at[0].set(seq), st)
         done = seq >= jnp.int32(writes)
         eb = ctx.emits()
-        eb.send(PRIMARY, user_kind(_H_WRITE), (seq + 1,), when=fresh & ~done)
+        eb.send(
+            PRIMARY, user_kind(_H_WRITE), (seq + 1,),
+            when=fresh & ~done, pay=_client_value(ctx) if payload else (),
+        )
         eb.send(PRIMARY, user_kind(_H_FIN), (), when=fresh & done)
         return new, eb.build()
 
@@ -155,7 +200,10 @@ def make_kvchaos(
         # committed but the client may not know (lost COMMIT): re-ack
         pending_commit = current & (st[0] >= seq)
         eb = ctx.emits()
-        _replicate(eb, seq, pending_repl, st[2])
+        _replicate(
+            eb, seq, pending_repl, st[2],
+            (st[4], st[5]) if payload else (),
+        )
         eb.send(client, user_kind(_H_COMMIT), (st[0],), when=pending_commit)
         eb.after(
             retx_ns, user_kind(_H_RETX), PRIMARY, (seq,),
@@ -170,7 +218,8 @@ def make_kvchaos(
         waiting = st[0] < jnp.int32(writes)
         eb = ctx.emits()
         eb.send(
-            PRIMARY, user_kind(_H_WRITE), (st[0] + 1,), when=waiting
+            PRIMARY, user_kind(_H_WRITE), (st[0] + 1,), when=waiting,
+            pay=_client_value(ctx) if payload else (),
         )
         eb.send(PRIMARY, user_kind(_H_FIN), (), when=~waiting)
         eb.after(client_retx_ns, user_kind(_H_CRETX), client)
@@ -207,9 +256,9 @@ def make_kvchaos(
         return new, eb.build()
 
     return Workload(
-        name="kvchaos",
+        name="kvchaos-payload" if payload else "kvchaos",
         n_nodes=n,
-        state_width=4,
+        state_width=width,
         handlers=(
             on_init, on_write, on_repl, on_ack, on_commit, on_retx,
             on_cretx, on_fin, on_join, on_jretx,
@@ -217,4 +266,5 @@ def make_kvchaos(
         # on_init builds up to 5 rows (write/cretx + join/jretx + 2 chaos);
         # on_retx builds n_replicas+2
         max_emits=max(n_replicas + 2, 6),
+        payload_words=2 if payload else 0,
     )

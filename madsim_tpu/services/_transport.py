@@ -14,8 +14,7 @@ from __future__ import annotations
 from typing import Any, Awaitable, Callable, Type
 
 from ..net.addr import AddrLike
-from ..net.endpoint import Endpoint
-from ..runtime.task import spawn
+from ._dual import bind_endpoint, spawn
 
 __all__ = ["RequestClient", "ResponseStream", "StreamReply", "serve_requests"]
 
@@ -70,10 +69,11 @@ class ResponseStream:
         return None  # "end"
 
     def close(self) -> None:
-        """Cancel the stream; the server's next send fails and its
-        generator unwinds."""
+        """Cancel the stream; the server notices (send failure in sim,
+        eof watcher on the std backend) and unwinds its generator."""
         self._done = True
         self._tx.close()
+        self._rx.close()
 
 
 class RequestClient:
@@ -83,10 +83,17 @@ class RequestClient:
     the service's own error type.
     """
 
-    def __init__(self, ep: Endpoint, dst, transport_error: Callable[[str], Exception]):
+    def __init__(self, ep, dst, transport_error: Callable[[str], Exception]):
         self._ep = ep
         self._dst = dst
         self._err = transport_error
+
+    async def close(self) -> None:
+        """Release the underlying endpoint (the std backend holds real
+        sockets and reader tasks; the sim endpoint a port-table entry)."""
+        res = self._ep.close()
+        if res is not None and hasattr(res, "__await__"):
+            await res
 
     async def call(self, op: str, **kwargs: Any) -> Any:
         try:
@@ -100,7 +107,9 @@ class RequestClient:
             raise self._err(str(e)) from e
         finally:
             # one request per connection: release pipes + pump tasks
+            # (and the receive tag, on the std backend)
             tx.close()
+            rx.close()
         if reply is None:
             raise self._err("connection reset")
         status, payload = reply
@@ -119,13 +128,16 @@ class RequestClient:
             raise self._err(str(e)) from e
         if first is None:
             tx.close()
+            rx.close()
             raise self._err("connection reset")
         status, payload = first
         if status == "err":
             tx.close()
+            rx.close()
             raise payload
         if status != "ok-stream":
             tx.close()
+            rx.close()
             raise self._err(f"expected a stream, got {status!r}")
         return ResponseStream(tx, rx, self._err)
 
@@ -139,11 +151,41 @@ async def serve_requests(
     """Server accept loop: each connection carries one (op, kwargs)
     request; the handler's return value (or raised ``error_type``) is
     the reply. Replies are half-closed so they drain through the pump
-    before the peer sees EOF."""
-    ep = await Endpoint.bind(addr)
+    before the peer sees EOF. Dual-mode: binds the sim Endpoint inside
+    a simulation, the std TCP Endpoint outside."""
+    ep = await bind_endpoint(addr)
     while True:
         tx, rx, _peer = await ep.accept1()
         spawn(_serve_one(tx, rx, handler, error_type), name=name)
+
+
+async def _stream_items(tx, rx, gen, error_type) -> None:
+    # cancellation watcher: the client closing its end surfaces as EOF
+    # on our receive half (both backends), stopping the stream at its
+    # next item instead of streaming to a closed peer forever
+    cancelled = False
+
+    async def watch():
+        nonlocal cancelled
+        while await rx.recv() is not None:
+            pass
+        cancelled = True
+
+    watcher = spawn(watch(), name="stream-cancel-watch")
+    try:
+        async for item in gen:
+            if cancelled:
+                return
+            await tx.send(("item", item))
+        await tx.send(("end", None))
+    finally:
+        watcher.cancel()
+        try:
+            await gen.aclose()
+        except RuntimeError:
+            # task teardown delivered GeneratorExit while the generator
+            # was suspended under this very frame; it is already unwinding
+            pass
 
 
 async def _serve_one(tx, rx, handler, error_type) -> None:
@@ -156,18 +198,7 @@ async def _serve_one(tx, rx, handler, error_type) -> None:
             result = await handler(op, kwargs)
             if isinstance(result, StreamReply):
                 await tx.send(("ok-stream", None))
-                try:
-                    async for item in result.gen:
-                        await tx.send(("item", item))
-                    await tx.send(("end", None))
-                finally:
-                    try:
-                        await result.gen.aclose()
-                    except RuntimeError:
-                        # task teardown delivered GeneratorExit while the
-                        # generator was suspended under this very frame;
-                        # it is already unwinding
-                        pass
+                await _stream_items(tx, rx, result.gen, error_type)
             else:
                 await tx.send(("ok", result))
         except error_type as e:

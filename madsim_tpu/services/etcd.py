@@ -11,14 +11,19 @@ Parity with the reference's madsim-etcd-client (madsim-etcd-client/src/):
   * leases tick down once per simulated second and expiry deletes
     attached keys (service.rs:20-26, 353-370)
   * election campaign parks waiters in FIFO order and wakes the next
-    on resign/expiry (poll_campaign, service.rs:372-409); ``observe`` is
-    unimplemented server-side exactly like the reference (server.rs:60)
+    on resign/expiry (poll_campaign, service.rs:372-409); ``observe``
+    streams leader changes — implemented here although the reference
+    server answers it Unimplemented (server.rs:60)
   * fault injection: with probability ``timeout_rate`` a request stalls
     5-15 simulated seconds and fails UNAVAILABLE (service.rs:113-124)
 
 Client classes mirror the etcd-client API shape (KvClient, LeaseClient,
 ElectionClient); every op is one connection round-trip like the
 reference's kv.rs:25-100. Values are bytes; keys are bytes.
+
+Dual-mode (the reference's cfg-switch contract, lib.rs:1-8): inside a
+simulation the server and clients ride the simulated network; outside,
+the same classes run over real localhost TCP via madsim_tpu.std.net.
 """
 
 from __future__ import annotations
@@ -26,11 +31,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from ..net.addr import AddrLike, parse_addr
-from ..net.endpoint import Endpoint
-from ..runtime.rand import thread_rng
-from ..runtime.task import spawn
-from ..runtime.time_ import sleep
-from ..sync import Notify
+from ._dual import bind_endpoint, make_notify, rng, sleep, spawn
 from ._transport import RequestClient, ResponseStream, StreamReply, serve_requests
 
 __all__ = [
@@ -393,7 +394,7 @@ class SimServer:
     def __init__(self, timeout_rate: float = 0.0):
         self.timeout_rate = timeout_rate
         self._inner = _ServiceInner()
-        self._election_notify = Notify()
+        self._election_notify = make_notify()
 
     def with_timeout_rate(self, rate: float) -> "SimServer":
         self.timeout_rate = rate
@@ -413,8 +414,8 @@ class SimServer:
 
     async def _handle(self, op: str, kwargs: dict) -> Any:
         # fault injection (service.rs:113-124): stall then Unavailable
-        if self.timeout_rate > 0 and thread_rng().random_bool(self.timeout_rate):
-            await sleep(thread_rng().randrange(5, 15))
+        if self.timeout_rate > 0 and rng().random_bool(self.timeout_rate):
+            await sleep(rng().randrange(5, 15))
             raise EtcdError("GRpcStatus", "Unavailable")
         return await self._dispatch(op, kwargs)
 
@@ -440,7 +441,7 @@ class SimServer:
                 self._election_notify.notify_waiters()
             return r
         if op == "lease_grant":
-            return inner.lease_grant(kw["ttl"], kw["id"], thread_rng())
+            return inner.lease_grant(kw["ttl"], kw["id"], rng())
         if op == "lease_revoke":
             r = inner.lease_revoke(kw["id"])
             self._election_notify.notify_waiters()
@@ -512,7 +513,7 @@ class SimServer:
 class _Raw(RequestClient):
     """One-connection-per-request client core (kv.rs:25-100 pattern)."""
 
-    def __init__(self, ep: Endpoint, dst):
+    def __init__(self, ep, dst):
         super().__init__(
             ep, dst, lambda m: EtcdError("GRpcStatus", f"Unavailable: {m}")
         )
@@ -530,8 +531,11 @@ class Client:
         if isinstance(endpoints, (str, tuple)):
             endpoints = [endpoints]
         dst = parse_addr(endpoints[0])
-        ep = await Endpoint.bind("0.0.0.0:0")
+        ep = await bind_endpoint("0.0.0.0:0")
         return cls(_Raw(ep, dst))
+
+    async def close(self) -> None:
+        await self._raw.close()
 
     def kv_client(self) -> "KvClient":
         return KvClient(self._raw)

@@ -32,10 +32,9 @@ import inspect
 from typing import Any, AsyncIterator, Callable, Optional
 
 from ..net.addr import AddrLike, SocketAddr, parse_addr
-from ..net.endpoint import Endpoint, PipeReceiver, PipeSender
 from ..runtime.future import SimFuture
-from ..runtime.task import spawn
 from ..sync import ChannelClosed
+from ._dual import bind_endpoint, in_sim, spawn
 
 __all__ = [
     "Code",
@@ -146,7 +145,7 @@ class Streaming:
     (codec.rs:13-48). Ends on the end marker; raises Status on error;
     a dropped/reset peer surfaces UNAVAILABLE."""
 
-    def __init__(self, rx: PipeReceiver, own_connection: bool = True):
+    def __init__(self, rx, own_connection: bool = True):
         self._rx = rx
         self._done = False
         # server-side request streams must not close the connection when
@@ -249,23 +248,33 @@ class Router:
     ) -> None:
         """Bind and accept until ``signal`` resolves (server.rs:202-260).
         Each accepted connection carries exactly one call."""
-        ep = await Endpoint.bind(addr)
+        ep = await bind_endpoint(addr)
         loop = spawn(self._accept_loop(ep), name="grpc-accept-loop")
         if signal is None:
             await loop
             return
-        from ..runtime.future import select
+        if in_sim():
+            from ..runtime.future import select
 
-        idx, _ = await select(loop._fut, signal)
-        if idx == 1:
-            loop.abort()
+            idx, _ = await select(loop._handle._fut, signal)
+            if idx == 1:
+                loop.cancel()
+        else:
+            import asyncio as _aio
 
-    async def _accept_loop(self, ep: Endpoint) -> None:
+            sig = _aio.ensure_future(signal)
+            done, _pending = await _aio.wait(
+                [loop, sig], return_when=_aio.FIRST_COMPLETED
+            )
+            if sig in done:
+                loop.cancel()
+
+    async def _accept_loop(self, ep) -> None:
         while True:
             tx, rx, peer = await ep.accept1()
             spawn(self._serve_conn(tx, rx, peer), name="grpc-conn")
 
-    async def _serve_conn(self, tx: PipeSender, rx: PipeReceiver, peer) -> None:
+    async def _serve_conn(self, tx, rx, peer) -> None:
         try:
             first = await rx.recv()
         except (ChannelClosed, EOFError, ConnectionError):
@@ -350,13 +359,13 @@ class Channel:
     addresses fail fast with UNAVAILABLE, then each call opens its own
     connection (client.rs:29-53 does the same per-call connect1)."""
 
-    def __init__(self, ep: Endpoint, dst: SocketAddr):
+    def __init__(self, ep, dst: SocketAddr):
         self._ep = ep
         self._dst = dst
 
     @classmethod
     async def connect(cls, dst: AddrLike) -> "Channel":
-        ep = await Endpoint.bind("0.0.0.0:0")
+        ep = await bind_endpoint("0.0.0.0:0")
         dst_a = parse_addr(dst)
         try:
             tx, _rx = await ep.connect1(dst_a)
@@ -365,7 +374,14 @@ class Channel:
         tx.close()
         return cls(ep, dst_a)
 
-    async def _open(self) -> tuple[PipeSender, PipeReceiver]:
+    async def close(self) -> None:
+        """Release the channel's endpoint (sockets/reader tasks on the
+        std backend; a port-table entry in simulation)."""
+        res = self._ep.close()
+        if res is not None and hasattr(res, "__await__"):
+            await res
+
+    async def _open(self):
         try:
             return await self._ep.connect1(self._dst)
         except (ConnectionError, OSError) as e:
@@ -411,7 +427,7 @@ class Channel:
 class _SendHalf:
     """Client-side request stream (send_request_stream, client.rs:126-146)."""
 
-    def __init__(self, tx: PipeSender):
+    def __init__(self, tx):
         self._tx = tx
 
     async def send(self, msg: Any) -> None:

@@ -26,9 +26,7 @@ from __future__ import annotations
 from typing import Any, Iterable, Optional
 
 from ..net.addr import AddrLike, parse_addr
-from ..net.endpoint import Endpoint
-from ..runtime.time_ import now_ns, sleep
-from ..sync import Notify
+from ._dual import bind_endpoint, make_notify, now_ns, sleep
 from ._transport import RequestClient, serve_requests
 
 __all__ = [
@@ -151,7 +149,7 @@ class SimBroker:
         # topic -> list of partition logs; each log is a list of Message
         self.topics: dict[str, list[list[Message]]] = {}
         self._rr: dict[str, int] = {}  # round-robin cursor per topic
-        self._data_notify = Notify()
+        self._data_notify = make_notify()
 
     async def serve(self, addr: AddrLike) -> None:
         await serve_requests(addr, self._dispatch, KafkaError, name="kafka-request")
@@ -240,7 +238,7 @@ class SimBroker:
 
 
 class _Raw(RequestClient):
-    def __init__(self, ep: Endpoint, dst):
+    def __init__(self, ep, dst):
         super().__init__(
             ep, dst, lambda m: KafkaError("BrokerTransportFailure", m)
         )
@@ -265,12 +263,15 @@ class ClientConfig:
         if not servers:
             raise KafkaError("ClientConfig", "bootstrap.servers not set")
         dst = parse_addr(servers.split(",")[0])
-        ep = await Endpoint.bind("0.0.0.0:0")
+        ep = await bind_endpoint("0.0.0.0:0")
         return cls(_Raw(ep, dst), self)
 
 
 class BaseProducer:
     """Buffering producer (producer.rs:173-224)."""
+
+    async def close(self) -> None:
+        await self._raw.close()
 
     def __init__(self, raw: _Raw, config: ClientConfig):
         self._raw = raw
@@ -340,6 +341,9 @@ class BaseProducer:
 class FutureProducer:
     """Awaitable per-record producer: returns (partition, offset)."""
 
+    async def close(self) -> None:
+        await self._raw.close()
+
     def __init__(self, raw: _Raw, config: ClientConfig):
         self._raw = raw
 
@@ -356,6 +360,9 @@ class FutureProducer:
 class BaseConsumer:
     """Pull consumer with assign/subscribe + cached fetch
     (consumer.rs:49-207)."""
+
+    async def close(self) -> None:
+        await self._raw.close()
 
     def __init__(self, raw: _Raw, config: ClientConfig):
         self._raw = raw
@@ -438,6 +445,9 @@ class StreamConsumer(BaseConsumer):
 
 
 class AdminClient:
+    async def close(self) -> None:
+        await self._raw.close()
+
     def __init__(self, raw: _Raw, config: ClientConfig):
         self._raw = raw
 

@@ -32,10 +32,11 @@ from typing import Any, Awaitable, Callable, Optional
 
 from ..net.rpc import rpc_id
 
-__all__ = ["Endpoint"]
+__all__ = ["Endpoint", "StdPipeSender", "StdPipeReceiver"]
 
 _HEAD = struct.Struct(">QQ")  # payload length, tag
 _HELLO_TAG = (1 << 64) - 1
+_CONN_TAG = (1 << 64) - 2  # connection setup ("syn") messages
 
 Addr = tuple[str, int]
 
@@ -205,11 +206,46 @@ class Endpoint:
             task.add_done_callback(self._reader_tasks.discard)
             return writer
 
+    # ---- connections (sim Endpoint connect1/accept1 parity) --------------
+    async def connect1(self, dst) -> tuple["StdPipeSender", "StdPipeReceiver"]:
+        """Open a reliable ordered duplex "connection" to a peer endpoint
+        over the real network — the std mirror of the sim Endpoint's
+        ``connect1`` (sim/net/endpoint.rs:176-209), so service clients
+        written against the sim surface run on real TCP unchanged.
+
+        The connection is a pair of direction tags multiplexed over this
+        endpoint's TCP link; items ride as ("d", obj) with an ("eof",)
+        sentinel for half-close. Unreachable peers fail fast (the TCP
+        dial happens here)."""
+        dst_a = _parse(dst)
+        c2s = random.getrandbits(61) | (1 << 62)  # top bit clear: no clash
+        s2c = c2s | (1 << 61)                     # with RPC response tags
+        host, port = self._addr
+        try:
+            await self._send_tagged(dst_a, _CONN_TAG, ("syn", c2s, s2c, (host, port)))
+        except OSError as e:
+            raise ConnectionRefusedError(f"connect to {dst_a} failed: {e}") from e
+        return (
+            StdPipeSender(self, dst_a, c2s),
+            StdPipeReceiver(self, s2c),
+        )
+
+    async def accept1(self) -> tuple["StdPipeSender", "StdPipeReceiver", Addr]:
+        """Accept one connection (sim ``accept1`` mirror): returns
+        (sender, receiver, peer_addr)."""
+        (kind, c2s, s2c, reply_addr), src = await self._mailbox.recv(_CONN_TAG)
+        assert kind == "syn"
+        peer = (src[0], reply_addr[1]) if reply_addr[0] in ("0.0.0.0", "::") else tuple(reply_addr)
+        return StdPipeSender(self, peer, s2c), StdPipeReceiver(self, c2s), peer
+
     # ---- tag-matching datagram surface ----------------------------------
     async def send_to(self, dst, tag: int, payload: Any) -> None:
-        if tag >= _HELLO_TAG or tag < 0:
-            raise ValueError("tag 2**64-1 is reserved for the handshake")
-        writer = await self._writer_for(_parse(dst))
+        if tag >= _CONN_TAG or tag < 0:
+            raise ValueError("the top two tag values are reserved")
+        await self._send_tagged(_parse(dst), tag, payload)
+
+    async def _send_tagged(self, dst: Addr, tag: int, payload: Any) -> None:
+        writer = await self._writer_for(dst)
         writer.write(self._frame(tag, pickle.dumps(payload)))
         await writer.drain()
 
@@ -279,3 +315,68 @@ class Endpoint:
         task = loop.create_task(serve_loop())
         self._reader_tasks.add(task)
         task.add_done_callback(self._reader_tasks.discard)
+
+
+class StdPipeSender:
+    """Sending half of a std connection — duck-types the sim
+    ``PipeSender`` (send / shutdown / close / is_closed) so code written
+    against sim connections runs on the real network."""
+
+    __slots__ = ("_ep", "_dst", "_tag", "_closed")
+
+    def __init__(self, ep: Endpoint, dst: Addr, tag: int):
+        self._ep = ep
+        self._dst = dst
+        self._tag = tag
+        self._closed = False
+
+    async def send(self, payload: Any) -> None:
+        if self._closed:
+            raise ConnectionResetError("connection closed")
+        await self._ep._send_tagged(self._dst, self._tag, ("d", payload))
+
+    def is_closed(self) -> bool:
+        return self._closed
+
+    def _send_eof(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        loop = asyncio.get_event_loop()
+        t = loop.create_task(self._ep._send_tagged(self._dst, self._tag, ("eof",)))
+        self._ep._reader_tasks.add(t)
+        t.add_done_callback(self._ep._reader_tasks.discard)
+
+    def shutdown(self) -> None:
+        """Half-close: the peer reads EOF after in-flight items."""
+        self._send_eof()
+
+    def close(self) -> None:
+        """Close the write direction (the receiver half is closed by its
+        own ``close``; unlike the sim there is no shared group object)."""
+        self._send_eof()
+
+
+class StdPipeReceiver:
+    """Receiving half of a std connection; ``recv`` returns None on EOF."""
+
+    __slots__ = ("_ep", "_tag", "_eof")
+
+    def __init__(self, ep: Endpoint, tag: int):
+        self._ep = ep
+        self._tag = tag
+        self._eof = False
+
+    async def recv(self) -> Any | None:
+        if self._eof:
+            return None
+        item, _src = await self._ep._mailbox.recv(self._tag)
+        if item[0] == "eof":
+            self._eof = True
+            self._ep._mailbox.drop_tag(self._tag)
+            return None
+        return item[1]
+
+    def close(self) -> None:
+        self._eof = True
+        self._ep._mailbox.drop_tag(self._tag)

@@ -1,0 +1,444 @@
+// Shared-memory fast-path transport — the kernel-bypass-class endpoint.
+//
+// The reference's std side offers optional high-performance transports
+// behind the same tag-matching Endpoint API: UCX RDMA
+// (madsim/src/std/net/ucx.rs:23-30) and eRPC/ibverbs
+// (madsim/src/std/net/erpc.rs:24-30), selected by cargo feature. No
+// RDMA NIC exists in this environment, so this component fills that
+// role honestly for the case those transports accelerate most —
+// same-host messaging: a POSIX shared-memory MPSC ring per endpoint.
+// Data transfer is two memcpys through /dev/shm with no socket
+// syscalls; blocking uses a process-shared robust mutex + condvars
+// (futexes — kernel entered only on contention/empty), which is the
+// same "bypass the network stack" idea as the reference's RDMA paths.
+//
+// Addressing matches the TCP transports ("ip:port"), so the Python
+// Endpoint seam (madsim_tpu/std/) can pick epoll-TCP or shm per peer
+// exactly like the reference's cargo features pick ucx/erpc.
+//
+// C ABI only (ctypes binding; no pybind11 in this environment).
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4d545055;  // "MTPU"
+constexpr uint64_t kDataCap = 8u << 20;  // ring data area per endpoint
+constexpr uint64_t kMaxFrame = kDataCap / 2;
+
+// Frame: u64 total_len (of what follows) | u64 tag | u32 src_ip_len |
+// u32 src_port | src_ip bytes | payload bytes, all written mod-capacity.
+struct ShmRing {
+  uint32_t magic;
+  uint32_t owner_pid;
+  uint64_t capacity;
+  pthread_mutex_t mu;
+  pthread_cond_t nonempty;
+  pthread_cond_t nonfull;
+  uint64_t head;  // read cursor (monotonic; offset = head % capacity)
+  uint64_t tail;  // write cursor
+  uint32_t closed;
+  uint8_t data[];
+};
+
+size_t ring_bytes() { return sizeof(ShmRing) + kDataCap; }
+
+std::string seg_name(const std::string& ip, int port) {
+  std::string n = "/mstpu_" + ip + "_" + std::to_string(port);
+  for (char& c : n)
+    if (c == '.' || c == ':') c = '-';
+  return n;
+}
+
+// mod-capacity copy helpers (at most two memcpys each)
+void ring_write(ShmRing* r, uint64_t pos, const void* src, uint64_t n) {
+  uint64_t off = pos % r->capacity;
+  uint64_t first = std::min(n, r->capacity - off);
+  memcpy(r->data + off, src, first);
+  if (n > first) memcpy(r->data, static_cast<const uint8_t*>(src) + first, n - first);
+}
+
+void ring_read(ShmRing* r, uint64_t pos, void* dst, uint64_t n) {
+  uint64_t off = pos % r->capacity;
+  uint64_t first = std::min(n, r->capacity - off);
+  memcpy(dst, r->data + off, first);
+  if (n > first) memcpy(static_cast<uint8_t*>(dst) + first, r->data, n - first);
+}
+
+// Robust process-shared lock: if a peer died holding the mutex, adopt
+// and mark it consistent (the ring may hold a torn frame; the owner
+// detects that via cursor sanity checks and resets).
+int lock_robust(ShmRing* r) {
+  int rc = pthread_mutex_lock(&r->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&r->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+bool init_ring(ShmRing* r) {
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  if (pthread_mutex_init(&r->mu, &ma) != 0) return false;
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  if (pthread_cond_init(&r->nonempty, &ca) != 0) return false;
+  if (pthread_cond_init(&r->nonfull, &ca) != 0) return false;
+  r->capacity = kDataCap;
+  r->head = r->tail = 0;
+  r->closed = 0;
+  r->owner_pid = static_cast<uint32_t>(getpid());
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  r->magic = kMagic;
+  return true;
+}
+
+struct Msg {
+  std::vector<uint8_t> data;
+  std::string src_ip;
+  int src_port;
+};
+
+struct PeerSeg {
+  int fd = -1;
+  ShmRing* ring = nullptr;
+
+  PeerSeg() = default;
+  PeerSeg(PeerSeg&& o) noexcept : fd(o.fd), ring(o.ring) {
+    o.fd = -1;
+    o.ring = nullptr;
+  }
+  PeerSeg& operator=(PeerSeg&& o) noexcept {
+    if (this != &o) {
+      this->~PeerSeg();
+      fd = o.fd;
+      ring = o.ring;
+      o.fd = -1;
+      o.ring = nullptr;
+    }
+    return *this;
+  }
+  PeerSeg(const PeerSeg&) = delete;
+  PeerSeg& operator=(const PeerSeg&) = delete;
+
+  ~PeerSeg() {
+    if (ring) munmap(ring, ring_bytes());
+    if (fd >= 0) ::close(fd);
+    ring = nullptr;
+    fd = -1;
+  }
+};
+
+struct ShmEndpoint {
+  std::string ip;
+  int port = 0;
+  std::string name;
+  int fd = -1;
+  ShmRing* ring = nullptr;
+  std::thread drain;
+  std::mutex mu;  // local mailbox lock
+  std::condition_variable cv;
+  bool closed = false;
+  std::map<uint64_t, std::deque<Msg>> mailbox;
+  std::map<std::string, PeerSeg> peers;  // "ip:port" -> mapped segment
+  std::mutex peers_mu;
+
+  ~ShmEndpoint() { close_all(); }
+
+  bool create(const char* want_ip, int want_port, int* out_port) {
+    ip = want_ip;
+    std::mt19937_64 rng(static_cast<uint64_t>(getpid()) * 2654435761u ^
+                        static_cast<uint64_t>(time(nullptr)));
+    for (int attempt = 0; attempt < 64; attempt++) {
+      int p = want_port != 0
+                  ? want_port
+                  : 20000 + static_cast<int>(rng() % 40000);  // ephemeral
+      std::string n = seg_name(ip, p);
+      int f = shm_open(n.c_str(), O_RDWR | O_CREAT | O_EXCL, 0600);
+      if (f < 0) {
+        if (errno == EEXIST) {
+          // stale segment from a dead process? adopt its name
+          int ef = shm_open(n.c_str(), O_RDWR, 0600);
+          if (ef >= 0) {
+            void* m = mmap(nullptr, ring_bytes(), PROT_READ | PROT_WRITE,
+                           MAP_SHARED, ef, 0);
+            bool stale = false;
+            if (m != MAP_FAILED) {
+              auto* r = static_cast<ShmRing*>(m);
+              stale = r->magic == kMagic && r->owner_pid != 0 &&
+                      kill(static_cast<pid_t>(r->owner_pid), 0) != 0 &&
+                      errno == ESRCH;
+              munmap(m, ring_bytes());
+            }
+            ::close(ef);
+            if (stale) {
+              shm_unlink(n.c_str());
+              attempt--;  // retry the same port against the fresh name
+              continue;
+            }
+          }
+          if (want_port != 0) return false;  // fixed port taken
+          continue;                          // pick another ephemeral
+        }
+        return false;
+      }
+      if (ftruncate(f, static_cast<off_t>(ring_bytes())) != 0) {
+        ::close(f);
+        shm_unlink(n.c_str());
+        return false;
+      }
+      void* m =
+          mmap(nullptr, ring_bytes(), PROT_READ | PROT_WRITE, MAP_SHARED, f, 0);
+      if (m == MAP_FAILED) {
+        ::close(f);
+        shm_unlink(n.c_str());
+        return false;
+      }
+      fd = f;
+      ring = static_cast<ShmRing*>(m);
+      if (!init_ring(ring)) return false;
+      port = p;
+      name = n;
+      if (out_port) *out_port = p;
+      drain = std::thread([this] { drain_loop(); });
+      return true;
+    }
+    return false;
+  }
+
+  // Move every complete frame from the shared ring into the local
+  // tag-matching mailbox. Runs on a dedicated thread so shared-ring
+  // occupancy stays near zero and senders almost never block.
+  void drain_loop() {
+    // no spin phases anywhere: this container runs on a single CPU,
+    // where busy-waiting starves the very thread being waited on
+    // (measured: a spin phase here turned an 11.7 us RTT into 760 us)
+    for (;;) {
+      if (lock_robust(ring) != 0) return;
+      while (ring->head == ring->tail && !ring->closed) {
+        timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        ts.tv_nsec += 200 * 1000 * 1000;  // 200 ms tick to notice close
+        if (ts.tv_nsec >= 1000000000) {
+          ts.tv_sec += 1;
+          ts.tv_nsec -= 1000000000;
+        }
+        int rc = pthread_cond_timedwait(&ring->nonempty, &ring->mu, &ts);
+        if (rc == EOWNERDEAD) pthread_mutex_consistent(&ring->mu);
+        {
+          std::lock_guard<std::mutex> g(mu);
+          if (closed) {
+            pthread_mutex_unlock(&ring->mu);
+            return;
+          }
+        }
+      }
+      if (ring->closed) {
+        pthread_mutex_unlock(&ring->mu);
+        return;
+      }
+      std::vector<std::pair<uint64_t, Msg>> batch;
+      while (ring->head != ring->tail) {
+        uint64_t len = 0;
+        ring_read(ring, ring->head, &len, 8);
+        if (len < 16 || len > kMaxFrame ||
+            len + 8 > ring->tail - ring->head) {
+          // torn frame (a writer died mid-write): drop everything
+          ring->head = ring->tail;
+          break;
+        }
+        std::vector<uint8_t> frame(len);
+        ring_read(ring, ring->head + 8, frame.data(), len);
+        ring->head += 8 + len;
+        uint64_t tag;
+        uint32_t ip_len, src_port;
+        memcpy(&tag, frame.data(), 8);
+        memcpy(&ip_len, frame.data() + 8, 4);
+        memcpy(&src_port, frame.data() + 12, 4);
+        if (16 + ip_len > len) continue;  // malformed
+        Msg m;
+        m.src_ip.assign(reinterpret_cast<char*>(frame.data() + 16), ip_len);
+        m.src_port = static_cast<int>(src_port);
+        m.data.assign(frame.begin() + 16 + ip_len, frame.end());
+        batch.emplace_back(tag, std::move(m));
+      }
+      pthread_cond_broadcast(&ring->nonfull);
+      pthread_mutex_unlock(&ring->mu);
+      if (!batch.empty()) {
+        std::lock_guard<std::mutex> g(mu);
+        for (auto& [tag, m] : batch) mailbox[tag].push_back(std::move(m));
+        cv.notify_all();
+      }
+    }
+  }
+
+  int do_send(const char* dst_ip, int dst_port, uint64_t tag,
+              const uint8_t* data, uint64_t len) {
+    if (len + 16 > kMaxFrame) return -1;
+    std::string key = std::string(dst_ip) + ":" + std::to_string(dst_port);
+    PeerSeg* seg;
+    {
+      std::lock_guard<std::mutex> g(peers_mu);
+      auto it = peers.find(key);
+      if (it == peers.end()) {
+        PeerSeg s;
+        std::string n = seg_name(dst_ip, dst_port);
+        s.fd = shm_open(n.c_str(), O_RDWR, 0600);
+        if (s.fd < 0) return -1;
+        void* m = mmap(nullptr, ring_bytes(), PROT_READ | PROT_WRITE,
+                       MAP_SHARED, s.fd, 0);
+        if (m == MAP_FAILED) return -1;
+        s.ring = static_cast<ShmRing*>(m);
+        if (s.ring->magic != kMagic) return -1;
+        it = peers.emplace(key, std::move(s)).first;
+        // moved-from PeerSeg must not close the now-owned fd/map
+      }
+      seg = &it->second;
+    }
+    ShmRing* r = seg->ring;
+    // frame body: tag | ip_len | src_port | ip | payload
+    uint32_t ip_len = static_cast<uint32_t>(ip.size());
+    uint64_t body = 16 + ip_len + len;
+    if (lock_robust(r) != 0) return -1;
+    while (r->capacity - (r->tail - r->head) < 8 + body && !r->closed) {
+      timespec ts;
+      clock_gettime(CLOCK_MONOTONIC, &ts);
+      ts.tv_sec += 5;  // bounded wait: a dead receiver can't wedge us
+      int rc = pthread_cond_timedwait(&r->nonfull, &r->mu, &ts);
+      if (rc == EOWNERDEAD) pthread_mutex_consistent(&r->mu);
+      if (rc == ETIMEDOUT &&
+          r->capacity - (r->tail - r->head) < 8 + body) {
+        pthread_mutex_unlock(&r->mu);
+        return -1;
+      }
+    }
+    if (r->closed) {
+      pthread_mutex_unlock(&r->mu);
+      return -1;
+    }
+    uint64_t pos = r->tail;
+    ring_write(r, pos, &body, 8);
+    ring_write(r, pos + 8, &tag, 8);
+    uint32_t src_port_u = static_cast<uint32_t>(port);
+    ring_write(r, pos + 16, &ip_len, 4);
+    ring_write(r, pos + 20, &src_port_u, 4);
+    ring_write(r, pos + 24, ip.data(), ip_len);
+    if (len) ring_write(r, pos + 24 + ip_len, data, len);
+    std::atomic_thread_fence(std::memory_order_release);
+    r->tail = pos + 8 + body;
+    pthread_cond_signal(&r->nonempty);
+    pthread_mutex_unlock(&r->mu);
+    return 0;
+  }
+
+  Msg* take(uint64_t tag, int64_t timeout_ms) {
+    std::unique_lock<std::mutex> g(mu);
+    auto ready = [&] {
+      auto it = mailbox.find(tag);
+      return closed || (it != mailbox.end() && !it->second.empty());
+    };
+    if (timeout_ms < 0) {
+      cv.wait(g, ready);
+    } else if (!cv.wait_for(g, std::chrono::milliseconds(timeout_ms), ready)) {
+      return nullptr;
+    }
+    auto it = mailbox.find(tag);
+    if (it == mailbox.end() || it->second.empty()) return nullptr;
+    Msg* m = new Msg(std::move(it->second.front()));
+    it->second.pop_front();
+    if (it->second.empty()) mailbox.erase(it);
+    return m;
+  }
+
+  void close_all() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      if (closed) return;
+      closed = true;
+      cv.notify_all();
+    }
+    if (ring) {
+      if (lock_robust(ring) == 0) {
+        ring->closed = 1;
+        pthread_cond_broadcast(&ring->nonempty);
+        pthread_cond_broadcast(&ring->nonfull);
+        pthread_mutex_unlock(&ring->mu);
+      }
+    }
+    if (drain.joinable()) drain.join();
+    {
+      std::lock_guard<std::mutex> g(peers_mu);
+      peers.clear();
+    }
+    if (ring) munmap(ring, ring_bytes());
+    if (fd >= 0) ::close(fd);
+    ring = nullptr;
+    fd = -1;
+    if (!name.empty()) shm_unlink(name.c_str());
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* shmep_bind(const char* ip, int port, int* out_port) {
+  auto* ep = new ShmEndpoint();
+  if (!ep->create(ip, port, out_port)) {
+    delete ep;
+    return nullptr;
+  }
+  return ep;
+}
+
+int shmep_send(void* h, const char* ip, int port, uint64_t tag,
+               const uint8_t* data, uint64_t len) {
+  return static_cast<ShmEndpoint*>(h)->do_send(ip, port, tag, data, len);
+}
+
+void* shmep_recv(void* h, uint64_t tag, int64_t timeout_ms) {
+  return static_cast<ShmEndpoint*>(h)->take(tag, timeout_ms);
+}
+
+uint64_t shmep_msg_len(void* m) { return static_cast<Msg*>(m)->data.size(); }
+
+const uint8_t* shmep_msg_data(void* m) {
+  return static_cast<Msg*>(m)->data.data();
+}
+
+const char* shmep_msg_src_ip(void* m) {
+  return static_cast<Msg*>(m)->src_ip.c_str();
+}
+
+int shmep_msg_src_port(void* m) { return static_cast<Msg*>(m)->src_port; }
+
+void shmep_msg_free(void* m) { delete static_cast<Msg*>(m); }
+
+void shmep_shutdown(void* h) { static_cast<ShmEndpoint*>(h)->close_all(); }
+
+void shmep_free(void* h) { delete static_cast<ShmEndpoint*>(h); }
+
+}  // extern "C"

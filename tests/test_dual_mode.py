@@ -1,0 +1,184 @@
+"""Dual-mode services: the SAME service classes that run in simulation
+run over real localhost TCP — the reference's cfg-switch drop-in
+contract (madsim-etcd-client/src/lib.rs:1-8; madsim-rdkafka vendors the
+real API for its std build)."""
+
+import asyncio
+
+import pytest
+
+from madsim_tpu.services import etcd, grpc, kafka
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Greeter:
+    SERVICE_NAME = "helloworld.Greeter"
+
+    async def say_hello(self, request):
+        return {"message": f"Hello {request.message['name']}!"}
+
+    async def lots_of_replies(self, request):
+        for i in range(3):
+            yield {"message": f"reply #{i}"}
+
+
+def test_greeter_over_real_tcp():
+    async def main():
+        server_task = asyncio.create_task(
+            grpc.Server.builder().add_service(Greeter()).serve("127.0.0.1:55061")
+        )
+        await asyncio.sleep(0.2)
+        try:
+            ch = await grpc.connect("127.0.0.1:55061")
+            c = grpc.service_client(Greeter, ch)
+            r = await asyncio.wait_for(c.say_hello({"name": "world"}), 10)
+            assert r["message"] == "Hello world!"
+            got = []
+            stream = await asyncio.wait_for(c.lots_of_replies({"name": "x"}), 10)
+            async for item in stream:
+                got.append(item["message"])
+            assert got == ["reply #0", "reply #1", "reply #2"]
+            await ch.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_etcd_kv_over_real_tcp():
+    async def main():
+        server = etcd.SimServer()
+        server_task = asyncio.create_task(server.serve("127.0.0.1:55062"))
+        await asyncio.sleep(0.2)
+        try:
+            c = await etcd.Client.connect(["127.0.0.1:55062"])
+            r1 = await asyncio.wait_for(c.put("k1", "v1"), 10)
+            r2 = await asyncio.wait_for(c.put("k1", "v2"), 10)
+            assert r2["header_revision"] == r1["header_revision"] + 1
+            g = await asyncio.wait_for(c.get("k1"), 10)
+            kv = g["kvs"][0]
+            assert kv.value == b"v2" and kv.version == 2
+            d = await asyncio.wait_for(
+                c.delete("k", etcd.DeleteOptions(prefix=True)), 10
+            )
+            assert d["deleted"] == 1
+            await c.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_etcd_txn_and_lease_over_real_tcp():
+    async def main():
+        server = etcd.SimServer()
+        server_task = asyncio.create_task(server.serve("127.0.0.1:55063"))
+        await asyncio.sleep(0.2)
+        try:
+            c = await etcd.Client.connect(["127.0.0.1:55063"])
+            await asyncio.wait_for(c.put("k", "1"), 10)
+            t = (
+                etcd.Txn()
+                .when([etcd.Compare.value("k", "=", "1")])
+                .and_then([etcd.TxnOp.put("k", "2")])
+                .or_else([etcd.TxnOp.put("k", "bad")])
+            )
+            r = await asyncio.wait_for(c.txn(t), 10)
+            assert r["succeeded"]
+            g = await asyncio.wait_for(c.get("k"), 10)
+            assert g["kvs"][0].value == b"2"
+            lease = await asyncio.wait_for(c.lease_client().grant(ttl=60), 10)
+            await asyncio.wait_for(
+                c.put("ephemeral", "x", etcd.PutOptions(lease=lease["id"])), 10
+            )
+            ttl = await asyncio.wait_for(
+                c.lease_client().time_to_live(lease["id"]), 10
+            )
+            assert ttl["keys"] == [b"ephemeral"]
+            await c.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_etcd_observe_over_real_tcp():
+    """Server-streaming (observe) and its cancellation work over the
+    std backend too."""
+
+    async def main():
+        server = etcd.SimServer()
+        server_task = asyncio.create_task(server.serve("127.0.0.1:55065"))
+        await asyncio.sleep(0.2)
+        try:
+            c1 = await etcd.Client.connect(["127.0.0.1:55065"])
+            obs = await etcd.Client.connect(["127.0.0.1:55065"])
+            lease = await asyncio.wait_for(c1.lease_client().grant(ttl=60), 10)
+            stream = await obs.election_client().observe("mayor")
+            win = await asyncio.wait_for(
+                c1.election_client().campaign("mayor", "alice", lease["id"]), 10
+            )
+            first = await asyncio.wait_for(stream.message(), 10)
+            assert first["kv"].value == b"alice"
+            await asyncio.wait_for(c1.election_client().proclaim(win["key"], "alice2"), 10)
+            second = await asyncio.wait_for(stream.message(), 10)
+            assert second["kv"].value == b"alice2"
+            stream.close()
+            await c1.close()
+            await obs.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_kafka_produce_fetch_over_real_tcp():
+    async def main():
+        broker = kafka.SimBroker()
+        server_task = asyncio.create_task(broker.serve("127.0.0.1:55064"))
+        await asyncio.sleep(0.2)
+        try:
+            cfg = kafka.ClientConfig().set("bootstrap.servers", "127.0.0.1:55064")
+            admin = await cfg.create(kafka.AdminClient)
+            await asyncio.wait_for(
+                admin.create_topics([kafka.NewTopic("t", 1)]), 10
+            )
+            producer = await cfg.create(kafka.FutureProducer)
+            for i in range(5):
+                await asyncio.wait_for(
+                    producer.send(kafka.BaseRecord.to("t").set_payload(f"m{i}")),
+                    10,
+                )
+            ccfg = (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", "127.0.0.1:55064")
+                .set("auto.offset.reset", "earliest")
+            )
+            consumer = await ccfg.create(kafka.BaseConsumer)
+            tpl = kafka.TopicPartitionList()
+            tpl.add_partition("t", 0)
+            await consumer.assign(tpl)
+            got = []
+            idle = 0
+            while len(got) < 5 and idle < 50:
+                msg = await asyncio.wait_for(consumer.poll(), 10)
+                if msg is None:
+                    idle += 1
+                    await asyncio.sleep(0.05)
+                else:
+                    got.append(msg.payload)
+            assert sorted(got) == [b"m0", b"m1", b"m2", b"m3", b"m4"]
+            for cl in (admin, producer, consumer):
+                await cl.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())

@@ -1,0 +1,167 @@
+"""Shared-memory fast-path transport (native/shm_transport.cpp) — the
+kernel-bypass-class endpoint filling the reference's UCX/eRPC role
+(std/net/ucx.rs:23-30, erpc.rs:24-30)."""
+
+import asyncio
+import shutil
+import time
+
+import pytest
+
+from madsim_tpu.std import fastpath
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("make") is None or shutil.which("g++") is None,
+    reason="native toolchain unavailable",
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_shm_roundtrip():
+    async def main():
+        a = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        b = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        try:
+            await a.send_to(("127.0.0.1", b.local_addr[1]), 5, {"x": [1, 2, 3]})
+            payload, src = await b.recv_from(5, timeout=5)
+            assert payload == {"x": [1, 2, 3]}
+            await b.send_to(src, 6, "pong")
+            payload2, _ = await a.recv_from(6, timeout=5)
+            assert payload2 == "pong"
+        finally:
+            a.close()
+            b.close()
+
+    run(main())
+
+
+def test_shm_recv_timeout_and_refused():
+    async def main():
+        a = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        try:
+            with pytest.raises(asyncio.TimeoutError):
+                await a.recv_from(1, timeout=0.2)
+            with pytest.raises(ConnectionError):
+                await a.send_to(("127.0.0.1", 1), 1, "nobody home")
+        finally:
+            a.close()
+
+    run(main())
+
+
+def test_shm_many_messages_ordered_per_tag():
+    async def main():
+        a = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        b = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        try:
+            for i in range(200):
+                await a.send_to(("127.0.0.1", b.local_addr[1]), 1, i)
+            got = [(await b.recv_from(1, timeout=5))[0] for _ in range(200)]
+            assert got == list(range(200))
+        finally:
+            a.close()
+            b.close()
+
+    run(main())
+
+
+def test_shm_large_payload():
+    async def main():
+        a = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        b = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        blob = bytes(range(256)) * 4096  # 1 MiB
+        try:
+            await a.send_to(("127.0.0.1", b.local_addr[1]), 2, blob)
+            payload, _ = await b.recv_from(2, timeout=10)
+            assert payload == blob
+        finally:
+            a.close()
+            b.close()
+
+    run(main())
+
+
+def test_shm_backpressure_does_not_deadlock():
+    """Two endpoints flooding each other: queued sends + the drain
+    thread keep both sides moving (the failure mode the epoll transport
+    guards against with EPOLLOUT queues)."""
+
+    async def main():
+        a = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        b = await fastpath.ShmEndpoint.bind("127.0.0.1:0")
+        blob = b"z" * 65536
+        n = 100
+        try:
+            async def flood(src, dst):
+                for _ in range(n):
+                    await src.send_to(("127.0.0.1", dst.local_addr[1]), 3, blob)
+
+            async def drain(ep):
+                for _ in range(n):
+                    await ep.recv_from(3, timeout=30)
+
+            await asyncio.wait_for(
+                asyncio.gather(flood(a, b), flood(b, a), drain(a), drain(b)),
+                timeout=60,
+            )
+        finally:
+            a.close()
+            b.close()
+
+    run(main())
+
+
+def test_pick_endpoint_prefers_shm_on_loopback():
+    async def main():
+        ep = await fastpath.pick_endpoint("127.0.0.1:0")
+        try:
+            assert isinstance(ep, fastpath.ShmEndpoint)
+        finally:
+            ep.close()
+
+    run(main())
+
+
+def _raw_pingpong_rtt(mod, prefix: str, n: int = 1500) -> float:
+    """Transport-level ping-pong RTT via the C ABI directly (the asyncio
+    wrapper's thread-pool hop costs ~90 us and would drown the
+    comparison)."""
+    import ctypes
+
+    lib = mod._load()
+    bind = getattr(lib, prefix + "bind")
+    send = getattr(lib, prefix + "send")
+    recv = getattr(lib, prefix + "recv")
+    free = getattr(lib, prefix + "msg_free")
+    pa, pb = ctypes.c_int(0), ctypes.c_int(0)
+    a = bind(b"127.0.0.1", 0, ctypes.byref(pa))
+    b = bind(b"127.0.0.1", 0, ctypes.byref(pb))
+    try:
+        send(a, b"127.0.0.1", pb.value, 1, b"x", 1)  # warm-up / connect
+        free(recv(b, 1, 5000))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            send(a, b"127.0.0.1", pb.value, 1, b"x", 1)
+            free(recv(b, 1, 5000))
+            send(b, b"127.0.0.1", pa.value, 2, b"y", 1)
+            free(recv(a, 2, 5000))
+        return (time.perf_counter() - t0) / n
+    finally:
+        getattr(lib, prefix + "shutdown")(a)
+        getattr(lib, prefix + "shutdown")(b)
+        getattr(lib, prefix + "free")(a)
+        getattr(lib, prefix + "free")(b)
+
+
+def test_shm_beats_epoll_on_loopback_latency():
+    """The headline claim of the fast path (the reference's UCX/eRPC
+    role): ping-pong round-trips through the shm ring beat the epoll
+    TCP transport on loopback."""
+    from madsim_tpu.std import native as native_mod
+
+    shm_rtt = _raw_pingpong_rtt(fastpath, "shmep_")
+    tcp_rtt = _raw_pingpong_rtt(native_mod, "msep_")
+    assert shm_rtt < tcp_rtt, (shm_rtt, tcp_rtt)

@@ -32,7 +32,7 @@ TARGET = 200_000.0  # BASELINE.json north star, sim_s/s
 # runner exits as soon as every seed halts. CPU-fallback seed counts are
 # capped so a wedged-tunnel round still finishes within budget.
 CONFIGS = {
-    "raft": (8192, 600, 128),
+    "raft": (65536, 600, 128),
     "microbench": (1024, 1100, 32),
     "pingpong": (1, 300, 64),
     "broadcast": (16384, 500, 128),

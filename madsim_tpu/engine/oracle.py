@@ -24,7 +24,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 _NATIVE = os.path.join(_REPO, "native")
 _LIB = os.path.join(_NATIVE, "lib", "liboracle.so")
 
-WORKLOAD_IDS = {"pingpong": 0, "microbench": 1, "raft-election": 2}
+WORKLOAD_IDS = {
+    "pingpong": 0,
+    "microbench": 1,
+    "raft-election": 2,
+    "broadcast": 3,
+    "kvchaos": 4,
+    "kvchaos-payload": 4,  # same C++ workload; payload flag via set_params
+}
 
 _lib = None
 
@@ -89,6 +96,22 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
             ctypes.c_int64(model_kwargs.get("timeout_min_ns", 150_000_000)),
             ctypes.c_int64(model_kwargs.get("timeout_max_ns", 300_000_000)),
+        )
+    elif wl.name == "broadcast":
+        lib.oracle_set_broadcast(
+            ctypes.c_int32(model_kwargs.get("rounds", 5)),
+            ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
+            ctypes.c_int64(model_kwargs.get("retx_ns", 50_000_000)),
+            ctypes.c_int32(1 if model_kwargs.get("partition", True) else 0),
+        )
+    elif wl.name in ("kvchaos", "kvchaos-payload"):
+        lib.oracle_set_kvchaos(
+            ctypes.c_int32(model_kwargs.get("writes", 20)),
+            ctypes.c_int32(model_kwargs.get("n_replicas", 4)),
+            ctypes.c_int64(model_kwargs.get("retx_ns", 40_000_000)),
+            ctypes.c_int64(model_kwargs.get("client_retx_ns", 100_000_000)),
+            ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
+            ctypes.c_int32(1 if wl.payload_words else 0),
         )
     else:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")

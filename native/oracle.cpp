@@ -103,11 +103,16 @@ struct Config {  // EngineConfig
   int64_t time_limit_ns;  // 0 = unlimited
 };
 
+// payload arena width cap (Workload.payload_words; engine events carry
+// W int32 words — engine/core.py ev_pay)
+constexpr int32_t kMaxPay = 4;
+
 struct Event {
   int64_t time;
   bool valid;
   int32_t kind, node, src, epoch, retry;
   int32_t args[4];
+  int32_t pay[kMaxPay] = {0, 0, 0, 0};
 };
 
 // one emit row (Emits)
@@ -117,6 +122,7 @@ struct Emit {
   int32_t kind = 0, dst = 0;
   int64_t delay = 0;
   int32_t args[4] = {0, 0, 0, 0};
+  int32_t pay[kMaxPay] = {0, 0, 0, 0};
 };
 
 struct Effects {
@@ -134,6 +140,7 @@ struct Ctx {
   const int32_t* args;   // (4,)
   int32_t src;
   Draw draw;
+  const int32_t* pay = nullptr;  // (W,) the event's payload words
 };
 
 // Workload interface: mirrors engine Workload. new_state is written by
@@ -142,6 +149,7 @@ struct Workload {
   int32_t n_nodes, state_width, n_handlers, max_emits;
   // handler(h, ctx, new_state_out, effects_out)
   void (*handler)(int32_t h, const Ctx&, int32_t*, Effects*);
+  int32_t payload_words = 0;  // engine Workload.payload_words
 };
 
 // ---- the step loop (engine/core.py make_step) ---------------------------
@@ -175,7 +183,8 @@ struct Sim {
     clog.assign(static_cast<size_t>(wl.n_nodes) * wl.n_nodes, 0);
   }
 
-  void trace_fold(int64_t t, int32_t kind, int32_t node, const int32_t* args) {
+  void trace_fold(int64_t t, int32_t kind, int32_t node, const int32_t* args,
+                  const int32_t* pay) {
     uint64_t h = static_cast<uint64_t>(t) * kTraceMix;
     h ^= static_cast<uint64_t>(static_cast<uint32_t>(kind)) << 32;
     h ^= static_cast<uint64_t>(static_cast<uint32_t>(node)) << 40;
@@ -184,6 +193,16 @@ struct Sim {
     uint64_t a2 = static_cast<uint32_t>(args[2]);
     uint64_t a3 = static_cast<uint32_t>(args[3]);
     h ^= a0 ^ (a1 << 8) ^ (a2 << 16) ^ (a3 << 24);
+    if (wl.payload_words > 0) {
+      // payload words participate in the trace (engine _trace_fold):
+      // h ^= sum_w pay[w] * (MIX ^ w), wrapping uint64
+      uint64_t acc = 0;
+      for (int32_t wi = 0; wi < wl.payload_words; wi++) {
+        acc += static_cast<uint64_t>(static_cast<uint32_t>(pay[wi])) *
+               (kTraceMix ^ static_cast<uint64_t>(wi));
+      }
+      h ^= acc;
+    }
     trace = trace * kTracePrime + h;
   }
 
@@ -207,6 +226,8 @@ struct Sim {
     int32_t kind = ev[i].kind, dst = ev[i].node, src = ev[i].src;
     int32_t args[4];
     std::memcpy(args, ev[i].args, sizeof(args));
+    int32_t pay[kMaxPay];  // copied now: the slot may be reused below
+    std::memcpy(pay, ev[i].pay, sizeof(pay));
     bool is_engine = kind < FIRST_USER_KIND;
     bool is_msg = src >= 0;
     bool live = alive[dst] && epoch[dst] == ev[i].epoch;
@@ -241,7 +262,7 @@ struct Sim {
     std::vector<int32_t> new_state(wl.state_width);
     const int32_t* row = &node_state[static_cast<size_t>(dst) * wl.state_width];
     std::memcpy(new_state.data(), row, wl.state_width * sizeof(int32_t));
-    Ctx ctx{now, dst, row, args, src, draw};
+    Ctx ctx{now, dst, row, args, src, draw, pay};
     int32_t safe_kind = kind < 0 ? 0 : kind;
     int32_t max_kind = FIRST_USER_KIND + wl.n_handlers - 1;
     if (safe_kind > max_kind) safe_kind = max_kind;
@@ -348,9 +369,10 @@ struct Sim {
                  : (e.dst >= 0 && e.dst < wl.n_nodes ? epoch[e.dst] : 0);
       ne.retry = 0;
       std::memcpy(ne.args, e.args, sizeof(ne.args));
+      std::memcpy(ne.pay, e.pay, sizeof(ne.pay));
     }
     msg_count += n_sends;
-    if (dispatch) trace_fold(now, kind, dst, args);
+    if (dispatch) trace_fold(now, kind, dst, args, pay);
     now = now_after;
     step += 1;
   }
@@ -545,6 +567,280 @@ void raft_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   }
 }
 
+// broadcast (models/broadcast.py): origin 0 broadcasts `rounds` sequenced
+// messages to n_nodes-1 peers with acks + retransmit, under a random link
+// partition the origin schedules at init.
+struct BroadcastParams {
+  int32_t rounds, n_nodes;
+  int64_t retx_ns;
+  int32_t partition;
+};
+BroadcastParams g_bc{5, 5, 50000000, 1};
+
+void broadcast_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t ORIGIN = 0;
+  const int32_t K_MSG = FIRST_USER_KIND + 1, K_ACK = FIRST_USER_KIND + 2,
+                K_RETX = FIRST_USER_KIND + 3;
+  const int32_t P_CHAOS_LINK = 1, P_CHAOS_AT = 2, P_CHAOS_LEN = 3;
+  const int32_t N = g_bc.n_nodes;
+  const int32_t n_peers = N - 1;
+  const int32_t full_mask = (1 << n_peers) - 1;
+  // slot order must match the Python EmitBuilder exactly: invalid emits
+  // still consume a slot index (latency/loss purposes are per-slot)
+  auto bcast = [&](int32_t seq, bool when) {
+    for (int32_t p = 1; p < N; p++)
+      eff->emits.push_back(mk_send(p, K_MSG, seq, 0, when));
+  };
+  switch (h) {
+    case 0: {  // on_init
+      bool is_origin = ctx.node == ORIGIN;
+      bcast(1, is_origin);
+      eff->emits.push_back(mk_after(g_bc.retx_ns, K_RETX, ORIGIN, 1, is_origin));
+      if (g_bc.partition) {
+        int64_t a = ctx.draw.user_int(1, N, P_CHAOS_LINK);
+        int64_t b_raw = ctx.draw.user_int(1, N - 1, P_CHAOS_LINK + 16);
+        int64_t b = b_raw >= a ? b_raw + 1 : b_raw;
+        int64_t at = ctx.draw.user_int(0, 100000000, P_CHAOS_AT);
+        int64_t length = ctx.draw.user_int(50000000, 400000000, P_CHAOS_LEN);
+        Emit e1 = mk_after(at, KIND_CLOG, 0, static_cast<int32_t>(a), is_origin);
+        e1.args[1] = static_cast<int32_t>(b);
+        eff->emits.push_back(e1);
+        Emit e2 = mk_after(at + length, KIND_UNCLOG, 0,
+                           static_cast<int32_t>(a), is_origin);
+        e2.args[1] = static_cast<int32_t>(b);
+        eff->emits.push_back(e2);
+      }
+      if (is_origin) ns[0] = 1;
+      break;
+    }
+    case 1: {  // on_msg at receiver
+      int32_t seq = ctx.args[0];
+      ns[0] = ctx.state[0] > seq ? ctx.state[0] : seq;
+      ns[1] = ctx.state[1] + 1;
+      // always ack (idempotent) so lost acks are re-covered by retx
+      eff->emits.push_back(mk_send(ORIGIN, K_ACK, seq, ctx.node));
+      break;
+    }
+    case 2: {  // on_ack at origin
+      int32_t seq = ctx.args[0], peer = ctx.args[1];
+      int32_t cur = ctx.state[0];
+      int32_t mask = ctx.state[1];
+      int32_t bit = int32_t{1} << (peer - 1);
+      if (seq == cur) mask |= bit;
+      bool complete = mask == full_mask;
+      bool last_round = cur >= g_bc.rounds;
+      int32_t nxt = (complete && !last_round) ? cur + 1 : cur;
+      int32_t new_mask = (complete && !last_round) ? 0 : mask;
+      bcast(nxt, complete && !last_round);
+      eff->emits.push_back(
+          mk_after(g_bc.retx_ns, K_RETX, ORIGIN, nxt, complete && !last_round));
+      eff->emits.push_back(
+          mk_after(0, KIND_HALT, 0, 0, complete && last_round));
+      ns[0] = nxt;
+      ns[1] = new_mask;
+      break;
+    }
+    case 3: {  // on_retx at origin
+      int32_t seq = ctx.args[0];
+      int32_t cur = ctx.state[0];
+      int32_t mask = ctx.state[1];
+      bool pending = seq == cur && mask != full_mask;
+      for (int32_t idx = 0; idx < n_peers; idx++) {
+        bool unacked = ((mask >> idx) & 1) == 0;
+        eff->emits.push_back(
+            mk_send(idx + 1, K_MSG, cur, 0, pending && unacked));
+      }
+      eff->emits.push_back(mk_after(g_bc.retx_ns, K_RETX, ORIGIN, cur, pending));
+      break;
+    }
+  }
+}
+
+// kvchaos (models/kvchaos.py): primary-backup KV store under a scheduled
+// replica kill/restart; payload mode carries two client-drawn value words
+// through WRITE/REPL messages (state_width 6, payload_words 2).
+struct KvParams {
+  int32_t writes, n_replicas;
+  int64_t retx_ns, client_retx_ns;
+  int32_t chaos, payload;
+};
+KvParams g_kv{20, 4, 40000000, 100000000, 1, 0};
+
+void kvchaos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t PRIMARY = 0;
+  const int32_t K_WRITE = FIRST_USER_KIND + 1, K_REPL = FIRST_USER_KIND + 2,
+                K_ACK = FIRST_USER_KIND + 3, K_COMMIT = FIRST_USER_KIND + 4,
+                K_RETX = FIRST_USER_KIND + 5, K_CRETX = FIRST_USER_KIND + 6,
+                K_FIN = FIRST_USER_KIND + 7, K_JOIN = FIRST_USER_KIND + 8,
+                K_JRETX = FIRST_USER_KIND + 9;
+  const int32_t P_KILL_AT = 0, P_KILL_WHO = 1, P_REVIVE = 2;
+  const int32_t P_VAL0 = 8, P_VAL1 = 9;
+  const int32_t R = g_kv.n_replicas;
+  const int32_t client = R + 1;
+  const int32_t majority = R / 2 + 1;
+  const int32_t full_mask = (1 << R) - 1;
+  const bool payload = g_kv.payload != 0;
+  auto client_value = [&](int32_t* v0, int32_t* v1) {
+    *v0 = static_cast<int32_t>(ctx.draw.user(P_VAL0));
+    *v1 = static_cast<int32_t>(ctx.draw.user(P_VAL1));
+  };
+  auto send_pay = [&](Emit e, int32_t p0, int32_t p1) {
+    if (payload) {
+      e.pay[0] = p0;
+      e.pay[1] = p1;
+    }
+    eff->emits.push_back(e);
+  };
+  // slots 0..R-1: REPL sends gated per-replica on the ack mask
+  auto replicate = [&](int32_t seq, bool when, int32_t mask, int32_t p0,
+                       int32_t p1) {
+    for (int32_t idx = 0; idx < R; idx++)
+      send_pay(mk_send(idx + 1, K_REPL, seq, 0,
+                       when && (((mask >> idx) & 1) == 0)),
+               p0, p1);
+  };
+  auto maybe_halt = [&](int32_t committed, int32_t mask, int32_t fin) {
+    eff->emits.push_back(mk_after(
+        0, KIND_HALT, 0, 0,
+        committed >= g_kv.writes && mask == full_mask && fin > 0));
+  };
+  switch (h) {
+    case 0: {  // on_init
+      bool is_client = ctx.node == client;
+      bool is_replica = ctx.node >= 1 && ctx.node <= R;
+      int32_t v0 = 0, v1 = 0;
+      if (payload) client_value(&v0, &v1);
+      send_pay(mk_send(PRIMARY, K_WRITE, 1, 0, is_client), v0, v1);
+      eff->emits.push_back(
+          mk_after(g_kv.client_retx_ns, K_CRETX, client, 0, is_client));
+      eff->emits.push_back(
+          mk_send(PRIMARY, K_JOIN, ctx.node, 0, is_replica));
+      eff->emits.push_back(
+          mk_after(g_kv.retx_ns, K_JRETX, ctx.node, 0, is_replica));
+      if (g_kv.chaos) {
+        int64_t who = ctx.draw.user_int(1, 1 + R, P_KILL_WHO);
+        int64_t at = ctx.draw.user_int(20000000, 300000000, P_KILL_AT);
+        int64_t revive = ctx.draw.user_int(100000000, 600000000, P_REVIVE);
+        eff->emits.push_back(mk_after(
+            at, KIND_KILL, 0, static_cast<int32_t>(who), is_client));
+        eff->emits.push_back(mk_after(
+            at + revive, KIND_RESTART, 0, static_cast<int32_t>(who), is_client));
+      }
+      break;
+    }
+    case 1: {  // on_write at primary
+      int32_t seq = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool fresh = seq > st[0] && seq > st[1];
+      if (fresh) {
+        ns[1] = seq;
+        ns[2] = 0;
+        if (payload) {
+          // the first WRITE to arrive for a seq fixes its value
+          ns[4] = ctx.pay[0];
+          ns[5] = ctx.pay[1];
+        }
+      }
+      int32_t p0 = payload ? ns[4] : 0, p1 = payload ? ns[5] : 0;
+      replicate(seq, fresh, 0, p0, p1);
+      eff->emits.push_back(
+          mk_after(g_kv.retx_ns, K_RETX, PRIMARY, seq, fresh));
+      break;
+    }
+    case 2: {  // on_repl at replica
+      int32_t seq = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool fresh = seq > st[0];
+      ns[0] = st[0] > seq ? st[0] : seq;
+      ns[1] = st[1] + 1;
+      if (payload && fresh) {
+        ns[2] = ctx.pay[0];
+        ns[3] = ctx.pay[1];
+      }
+      eff->emits.push_back(mk_send(PRIMARY, K_ACK, seq, ctx.node));
+      break;
+    }
+    case 3: {  // on_ack at primary
+      int32_t seq = ctx.args[0], who = ctx.args[1];
+      const int32_t* st = ctx.state;
+      int32_t bit = int32_t{1} << (who - 1);
+      bool current = seq == st[1];
+      int32_t mask = current ? (st[2] | bit) : st[2];
+      int32_t acks = 0;
+      for (int32_t idx = 0; idx < R; idx++) acks += (mask >> idx) & 1;
+      bool committed_now = current && seq > st[0] && acks >= majority;
+      int32_t committed = committed_now ? seq : st[0];
+      ns[0] = committed;
+      ns[2] = mask;
+      eff->emits.push_back(mk_send(client, K_COMMIT, committed, 0,
+                                   current && committed >= seq));
+      maybe_halt(committed, mask, st[3]);
+      break;
+    }
+    case 4: {  // on_commit at client
+      int32_t seq = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool fresh = seq > st[0];
+      if (fresh) ns[0] = seq;
+      bool done = seq >= g_kv.writes;
+      int32_t v0 = 0, v1 = 0;
+      if (payload) client_value(&v0, &v1);
+      send_pay(mk_send(PRIMARY, K_WRITE, seq + 1, 0, fresh && !done), v0, v1);
+      eff->emits.push_back(mk_send(PRIMARY, K_FIN, 0, 0, fresh && done));
+      break;
+    }
+    case 5: {  // on_retx at primary
+      int32_t seq = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool current = seq == st[1];
+      bool pending_repl = current && st[2] != full_mask;
+      bool pending_commit = current && st[0] >= seq;
+      replicate(seq, pending_repl, st[2], payload ? st[4] : 0,
+                payload ? st[5] : 0);
+      eff->emits.push_back(
+          mk_send(client, K_COMMIT, st[0], 0, pending_commit));
+      eff->emits.push_back(mk_after(g_kv.retx_ns, K_RETX, PRIMARY, seq,
+                                    pending_repl || pending_commit));
+      break;
+    }
+    case 6: {  // on_cretx at client
+      const int32_t* st = ctx.state;
+      bool waiting = st[0] < g_kv.writes;
+      int32_t v0 = 0, v1 = 0;
+      if (payload) client_value(&v0, &v1);
+      send_pay(mk_send(PRIMARY, K_WRITE, st[0] + 1, 0, waiting), v0, v1);
+      eff->emits.push_back(mk_send(PRIMARY, K_FIN, 0, 0, !waiting));
+      eff->emits.push_back(
+          mk_after(g_kv.client_retx_ns, K_CRETX, client, 0, true));
+      break;
+    }
+    case 7: {  // on_fin at primary
+      const int32_t* st = ctx.state;
+      ns[3] = 1;
+      maybe_halt(st[0], st[2], 1);
+      break;
+    }
+    case 8: {  // on_join at primary
+      int32_t who = ctx.args[0];
+      const int32_t* st = ctx.state;
+      int32_t bit = int32_t{1} << (who - 1);
+      ns[2] = st[2] & ~bit;
+      // the retx timer may have died while the mask was full: re-arm
+      eff->emits.push_back(
+          mk_after(g_kv.retx_ns, K_RETX, PRIMARY, st[1], st[1] > 0));
+      break;
+    }
+    case 9: {  // on_jretx at replica
+      const int32_t* st = ctx.state;
+      bool behind = st[0] == 0;
+      eff->emits.push_back(mk_send(PRIMARY, K_JOIN, ctx.node, 0, behind));
+      eff->emits.push_back(
+          mk_after(g_kv.retx_ns, K_JRETX, ctx.node, 0, behind));
+      break;
+    }
+  }
+}
+
 Workload make_workload(int32_t id) {
   switch (id) {
     case 0:  // pingpong
@@ -553,6 +849,17 @@ Workload make_workload(int32_t id) {
       return Workload{1, 4, 2, 2, microbench_handler};
     case 2:  // raft
       return Workload{g_raft.n_nodes, 6, 5, g_raft.n_nodes + 1, raft_handler};
+    case 3: {  // broadcast: max_emits = max(n_peers + 3, 6)
+      int32_t k = g_bc.n_nodes - 1 + 3;
+      if (k < 6) k = 6;
+      return Workload{g_bc.n_nodes, 4, 4, k, broadcast_handler};
+    }
+    case 4: {  // kvchaos: max_emits = max(n_replicas + 2, 6)
+      int32_t k = g_kv.n_replicas + 2;
+      if (k < 6) k = 6;
+      return Workload{g_kv.n_replicas + 2, g_kv.payload ? 6 : 4, 10, k,
+                      kvchaos_handler, g_kv.payload ? 2 : 0};
+    }
     default:
       return Workload{0, 0, 0, 0, nullptr};
   }
@@ -571,6 +878,15 @@ void oracle_set_microbench(int32_t rounds, int64_t dmin, int64_t dmax) {
 }
 void oracle_set_raft(int32_t n_nodes, int64_t tmin, int64_t tmax) {
   g_raft = {n_nodes, tmin, tmax};
+}
+void oracle_set_broadcast(int32_t rounds, int32_t n_nodes, int64_t retx_ns,
+                          int32_t partition) {
+  g_bc = {rounds, n_nodes, retx_ns, partition};
+}
+void oracle_set_kvchaos(int32_t writes, int32_t n_replicas, int64_t retx_ns,
+                        int64_t client_retx_ns, int32_t chaos,
+                        int32_t payload) {
+  g_kv = {writes, n_replicas, retx_ns, client_retx_ns, chaos, payload};
 }
 
 // Run one seed for n_steps; returns 0 on success. Outputs mirror the

@@ -18,7 +18,13 @@ import jax
 
 from madsim_tpu.engine import EngineConfig, make_init, make_run, threefry2x32
 from madsim_tpu.engine.oracle import oracle_threefry, run_oracle
-from madsim_tpu.models import make_microbench, make_pingpong, make_raft
+from madsim_tpu.models import (
+    make_broadcast,
+    make_kvchaos,
+    make_microbench,
+    make_pingpong,
+    make_raft,
+)
 
 pytestmark = pytest.mark.skipif(
     shutil.which("make") is None or shutil.which("g++") is None,
@@ -85,6 +91,40 @@ def test_raft_with_time_limit_bit_identical():
     wl = make_raft()
     cfg = EngineConfig(pool_size=128, time_limit_ns=200_000_000)
     compare(wl, cfg, [3, 9, 27], 400)
+
+
+def test_broadcast_traces_bit_identical():
+    # partition chaos + packet loss: the clog/unclog + retransmit path
+    wl = make_broadcast(rounds=3)
+    cfg = EngineConfig(pool_size=128, loss_p=0.05)
+    compare(wl, cfg, list(range(12)), 400, rounds=3)
+
+
+def test_broadcast_no_partition_bit_identical():
+    wl = make_broadcast(rounds=2, partition=False)
+    cfg = EngineConfig(pool_size=128)
+    compare(wl, cfg, list(range(6)), 250, rounds=2, partition=False)
+
+
+def test_kvchaos_traces_bit_identical():
+    # kill/restart chaos + loss: epoch gating, restart re-init, rejoin
+    wl = make_kvchaos(writes=5)
+    cfg = EngineConfig(pool_size=128, loss_p=0.02)
+    compare(wl, cfg, list(range(12)), 500, writes=5)
+
+
+def test_kvchaos_payload_traces_bit_identical():
+    # the payload arena: client-drawn value words ride WRITE/REPL events
+    # and feed the trace hash — a payload divergence anywhere fails here
+    wl = make_kvchaos(writes=5, payload=True)
+    cfg = EngineConfig(pool_size=128, loss_p=0.02)
+    compare(wl, cfg, list(range(12)), 500, writes=5)
+
+
+def test_kvchaos_payload_no_chaos_bit_identical():
+    wl = make_kvchaos(writes=4, chaos=False, payload=True)
+    cfg = EngineConfig(pool_size=128)
+    compare(wl, cfg, list(range(6)), 400, writes=4, chaos=False)
 
 
 def test_big_seed_values():

@@ -1,0 +1,194 @@
+"""Ablation profile of one batched engine step (the SCALING.md evidence).
+
+Times the full jitted raft step against stripped variants that isolate
+the step's cost centers (pop/argmin, threefry draws, the lax.switch
+dispatch, the emit scatters) at a given seed count, so the engine
+optimization work attacks measured hot spots instead of guesses.
+
+Usage:  python examples/profile_step.py [n_seeds] [platform]
+Prints one JSON object per measurement plus a summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+if len(sys.argv) > 2 and sys.argv[2] == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from madsim_tpu.engine import EngineConfig, make_init, make_run, make_step
+from madsim_tpu.engine.core import _INF_NS
+from madsim_tpu.engine.rng import PURPOSE_LATENCY, PURPOSE_POLL_COST, Draw
+from madsim_tpu.models import make_raft
+
+N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+N_STEPS = 100
+REPEATS = 3
+
+
+def timed(name, fn, state):
+    """Median wall time of REPEATS runs of jitted fn (scanned N_STEPS)."""
+    jfn = jax.jit(fn)
+    jax.block_until_ready(jfn(state))  # compile
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jfn(state))
+        times.append(time.perf_counter() - t0)
+    wall = sorted(times)[len(times) // 2]
+    us_per_step = wall / N_STEPS * 1e6
+    rec = {
+        "variant": name,
+        "wall_s": round(wall, 4),
+        "us_per_step": round(us_per_step, 2),
+        "ns_per_seed_step": round(us_per_step * 1e3 / N_SEEDS, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def scan_n(body):
+    def run(st):
+        def f(s, _):
+            return body(s), None
+
+        out, _ = lax.scan(f, st, None, length=N_STEPS)
+        return out
+
+    return run
+
+
+def main():
+    wl = make_raft()
+    cfg = EngineConfig(pool_size=128, loss_p=0.02)
+    k = wl.max_emits
+    init = make_init(wl, cfg)
+    state = init(np.arange(N_SEEDS, dtype=np.uint64))
+    state = jax.block_until_ready(state)
+    platform = jax.devices()[0].platform
+    print(json.dumps({"platform": platform, "n_seeds": N_SEEDS, "pool": cfg.pool_size,
+                      "max_emits": k, "n_steps": N_STEPS}), flush=True)
+
+    results = {}
+
+    # 1. the real thing
+    step = jax.vmap(make_step(wl, cfg))
+    results["full_step"] = timed("full_step", scan_n(step), state)
+
+    # 2. pop only: argmin over the masked int64 pool
+    def pop_only(st):
+        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        i = jnp.argmin(tmask, axis=1)
+        rows = jnp.arange(st.ev_time.shape[0])
+        now = jnp.maximum(st.now, st.ev_time[rows, i])
+        return st.__class__(**{**st.__dict__, "now": now})
+
+    results["pop_argmin"] = timed("pop_argmin", scan_n(pop_only), state)
+
+    # 3. RNG draws: poll cost + K paired latency/loss blocks (bits2)
+    def draws_only(st):
+        def one(seed, stp):
+            draw = Draw(seed, stp)
+            cost = draw.uniform_int(cfg.proc_min_ns, cfg.proc_max_ns, PURPOSE_POLL_COST)
+            slot_ix = jnp.arange(k, dtype=jnp.uint32)
+            lat, loss = jax.vmap(
+                lambda s: draw.bits2(jnp.uint32(PURPOSE_LATENCY) + s)
+            )(slot_ix)
+            return cost + lat.astype(jnp.int64).sum() + loss.astype(jnp.int64).sum()
+
+        extra = jax.vmap(one)(st.seed, st.step)
+        return st.__class__(**{**st.__dict__, "now": st.now + extra,
+                               "step": st.step + jnp.uint32(1)})
+
+    results["rng_draws"] = timed("rng_draws", scan_n(draws_only), state)
+
+    # 4. gathers: the per-seed dynamic reads the dispatch needs
+    def gathers_only(st):
+        rows = jnp.arange(st.ev_time.shape[0])
+        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        i = jnp.argmin(tmask, axis=1)
+        kind = st.ev_kind[rows, i]
+        dst = st.ev_node[rows, i]
+        args = st.ev_args[rows, i]
+        nstate = st.node_state[rows, dst]
+        alive = st.alive[rows, dst]
+        acc = (kind + dst + args.sum(-1) + nstate.sum(-1) + alive).astype(jnp.int64)
+        return st.__class__(**{**st.__dict__, "now": st.now + acc})
+
+    results["pop_gathers"] = timed("pop_gathers", scan_n(gathers_only), state)
+
+    # 5. scatters: the emit-insertion writes (K slots into the E pool)
+    def scatters_only(st):
+        def one(ev_valid, ev_time, ev_kind, ev_node, ev_args, stp):
+            free = jnp.flatnonzero(~ev_valid, size=k, fill_value=ev_valid.shape[0])
+            e_valid = jnp.ones((k,), jnp.bool_)
+            slot = free
+            return (
+                ev_valid.at[slot].set(e_valid, mode="drop"),
+                ev_time.at[slot].set(jnp.full((k,), 7, jnp.int64), mode="drop"),
+                ev_kind.at[slot].set(jnp.full((k,), 1, jnp.int32), mode="drop"),
+                ev_node.at[slot].set(jnp.zeros((k,), jnp.int32), mode="drop"),
+                ev_args.at[slot].set(jnp.zeros((k, 4), jnp.int32), mode="drop"),
+            )
+
+        ev_valid, ev_time, ev_kind, ev_node, ev_args = jax.vmap(one)(
+            st.ev_valid, st.ev_time, st.ev_kind, st.ev_node, st.ev_args, st.step
+        )
+        return st.__class__(**{**st.__dict__, "ev_valid": ev_valid,
+                               "ev_time": ev_time, "ev_kind": ev_kind,
+                               "ev_node": ev_node, "ev_args": ev_args})
+
+    results["emit_scatters"] = timed("emit_scatters", scan_n(scatters_only), state)
+
+    # (switch cost is measured by subtraction: full - pop - rng - gathers
+    # - place; the branch table is internal to make_step)
+
+    # 6. dense placement math alone (the scatter replacement)
+    def place_only(st):
+        def one(ev_valid, ev_time, stp):
+            e_valid = jnp.ones((k,), jnp.bool_)
+            e_time = jnp.full((k,), 7, jnp.int64)
+            free_rank = jnp.cumsum(~ev_valid) - 1
+            pos = jnp.cumsum(e_valid.astype(jnp.int32)) - 1
+            match = (
+                (~ev_valid)[:, None]
+                & e_valid[None, :]
+                & (free_rank[:, None] == pos[None, :])
+            )
+            match_any = jnp.any(match, axis=1)
+            picked = jnp.sum(
+                jnp.where(match, e_time[None, :], 0), axis=1
+            ).astype(e_time.dtype)
+            return ev_valid | match_any, jnp.where(match_any, picked, ev_time)
+
+        ev_valid, ev_time = jax.vmap(one)(st.ev_valid, st.ev_time, st.step)
+        return st.__class__(**{**st.__dict__, "ev_valid": ev_valid, "ev_time": ev_time})
+
+    results["dense_place_2fields"] = timed(
+        "dense_place_2fields", scan_n(place_only), state
+    )
+
+    full = results["full_step"]["us_per_step"]
+    parts = {n: results[n]["us_per_step"] for n in results if n != "full_step"}
+    print(json.dumps({
+        "summary": {
+            "platform": platform,
+            "n_seeds": N_SEEDS,
+            "full_us_per_step": full,
+            "parts_us_per_step": parts,
+            "unattributed_us_per_step": round(full - sum(parts.values()), 2),
+        }
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -337,11 +337,13 @@ class Workload:
     payload_words: int = 0
 
     def __post_init__(self):
-        # emit slot s draws under PURPOSE_LATENCY(8)+s and
-        # PURPOSE_LOSS(64)+s; more than 56 slots would alias the two
-        # namespaces (and >64 would bleed into PURPOSE_USER), silently
-        # correlating "independent" draws
-        limit = PURPOSE_LOSS - PURPOSE_LATENCY
+        # emit slot s draws both its latency and loss words from the
+        # PURPOSE_LATENCY(8)+s block (Draw.bits2); the slot range must
+        # stay below the reserved PURPOSE_LOSS(64) space so it can never
+        # bleed toward PURPOSE_USER and correlate "independent" draws.
+        # -1: the engine appends one internal row (the restart re-init
+        # event) after the user slots
+        limit = PURPOSE_LOSS - PURPOSE_LATENCY - 1
         if self.max_emits > limit:
             raise ValueError(
                 f"max_emits={self.max_emits} exceeds the purpose-namespace "
@@ -389,39 +391,6 @@ class SimState:
     def sim_seconds(self):
         """Virtual seconds this instance has advanced (bench metric)."""
         return self.now.astype(jnp.float64) / 1e9
-
-
-@jax.tree_util.register_dataclass
-@dataclasses.dataclass
-class _Effects:
-    """Uniform output of every lax.switch branch."""
-
-    node_state: jnp.ndarray  # (U,)
-    emits: Emits
-    kill: jnp.ndarray  # int32 node or -1
-    restart: jnp.ndarray  # int32 node or -1
-    pause_node: jnp.ndarray  # int32 node or -1
-    pause_set: jnp.ndarray  # int32: 1 pause, 0 resume, -1 none
-    clog_a: jnp.ndarray  # int32
-    clog_b: jnp.ndarray  # int32 (-1 = whole node)
-    clog_set: jnp.ndarray  # int32: -1 none, 0 unclog, 1 clog
-    halt: jnp.ndarray  # bool
-
-
-def _no_effects(state_row: jnp.ndarray, k: int, w: int = 0) -> _Effects:
-    m1 = jnp.int32(-1)
-    return _Effects(
-        node_state=state_row,
-        emits=Emits.none(k, w),
-        kill=m1,
-        restart=m1,
-        pause_node=m1,
-        pause_set=m1,
-        clog_a=m1,
-        clog_b=m1,
-        clog_set=m1,
-        halt=jnp.asarray(False),
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -515,9 +484,14 @@ def make_step(wl: Workload, cfg: EngineConfig):
     k = wl.max_emits
     w = wl.payload_words
     init_rows = jnp.asarray(wl.initial_state())
-    n_branches = FIRST_USER_KIND + len(wl.handlers)
+    n_user = len(wl.handlers)
 
-    # -- switch branches ---------------------------------------------------
+    # -- user branch table -------------------------------------------------
+    # Only USER handlers go through lax.switch; engine kinds (kill, clog,
+    # halt, ...) are trivial functions of (kind, args) and are computed
+    # inline below as masked selects — under vmap a switch evaluates
+    # every branch and selects each output leaf, so ten extra engine
+    # branches cost real per-step op count for no information.
     # lax.switch operands must be pytrees, so the context travels as a
     # tuple of arrays and each branch rebuilds the HandlerCtx view.
     def _unpack(op) -> HandlerCtx:
@@ -534,84 +508,15 @@ def make_step(wl: Workload, cfg: EngineConfig):
             payload_words=w,
         )
 
-    def _engine_branch(effect_fn):
-        def branch(op):
-            ctx = _unpack(op)
-            eff = _no_effects(ctx.state, k, w)
-            return effect_fn(eff, ctx)
-
-        return branch
-
-    def _b_kill(eff, ctx):
-        return dataclasses.replace(eff, kill=ctx.args[0])
-
-    def _b_restart(eff, ctx):
-        # the reborn node re-runs its init handler — the stored-init-task
-        # respawn of task.rs:279-291
-        eb = EmitBuilder(k, w)
-        eb.after(0, FIRST_USER_KIND, ctx.args[0])
-        return dataclasses.replace(eff, restart=ctx.args[0], emits=eb.build())
-
-    def _b_clog(eff, ctx):
-        return dataclasses.replace(
-            eff, clog_a=ctx.args[0], clog_b=ctx.args[1], clog_set=jnp.int32(1)
-        )
-
-    def _b_unclog(eff, ctx):
-        return dataclasses.replace(
-            eff, clog_a=ctx.args[0], clog_b=ctx.args[1], clog_set=jnp.int32(0)
-        )
-
-    def _b_clog_node(eff, ctx):
-        return dataclasses.replace(
-            eff, clog_a=ctx.args[0], clog_b=jnp.int32(-1), clog_set=jnp.int32(1)
-        )
-
-    def _b_unclog_node(eff, ctx):
-        return dataclasses.replace(
-            eff, clog_a=ctx.args[0], clog_b=jnp.int32(-1), clog_set=jnp.int32(0)
-        )
-
-    def _b_halt(eff, ctx):
-        return dataclasses.replace(eff, halt=jnp.asarray(True))
-
-    def _b_pause(eff, ctx):
-        return dataclasses.replace(
-            eff, pause_node=ctx.args[0], pause_set=jnp.int32(1)
-        )
-
-    def _b_resume(eff, ctx):
-        return dataclasses.replace(
-            eff, pause_node=ctx.args[0], pause_set=jnp.int32(0)
-        )
-
-    def _b_nop(eff, ctx):
-        return eff
-
     def _user_branch(handler):
         def branch(op):
             ctx = _unpack(op)
             new_state, emits = handler(ctx)
-            eff = _no_effects(ctx.state, k, w)
-            return dataclasses.replace(
-                eff, node_state=jnp.asarray(new_state, jnp.int32), emits=emits
-            )
+            return jnp.asarray(new_state, jnp.int32), emits
 
         return branch
 
-    branches = [
-        _engine_branch(_b_kill),
-        _engine_branch(_b_restart),
-        _engine_branch(_b_clog),
-        _engine_branch(_b_unclog),
-        _engine_branch(_b_clog_node),
-        _engine_branch(_b_unclog_node),
-        _engine_branch(_b_halt),
-        _engine_branch(_b_nop),
-        _engine_branch(_b_pause),
-        _engine_branch(_b_resume),
-    ] + [_user_branch(h) for h in wl.handlers]
-    assert len(branches) == n_branches
+    user_branches = [_user_branch(h) for h in wl.handlers]
 
     loss_u32 = cfg.loss_u32
     time_limit = np.int64(cfg.time_limit_ns) if cfg.time_limit_ns else _INF_NS
@@ -619,29 +524,58 @@ def make_step(wl: Workload, cfg: EngineConfig):
     def step(st: SimState) -> SimState:
         # ---- pop the earliest pending event (the timer-jump of
         # time/mod.rs:45-60 merged with the ready-queue drain) ----
+        # Per-seed dynamic indexing (arr[i], arr[dst]) lowers to batched
+        # gathers under vmap, which measured ~1 ms/step on TPU
+        # (examples/profile_step.py). Every read below is instead a
+        # one-hot masked reduction over the small E or N axis — pure
+        # vector ALU work, bit-identical values. This also matches the
+        # oracle's out-of-range handling exactly (no gather clamping).
+        e_slots = st.ev_valid.shape[0]
         tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
         i = jnp.argmin(tmask)
-        has_event = st.ev_valid[i]
-        ev_t = jnp.maximum(st.now, st.ev_time[i])
+        slot_ids = jnp.arange(e_slots, dtype=jnp.int32)
+        is_popped = slot_ids == i.astype(jnp.int32)
+
+        def pick_slot(arr):
+            """arr (E, ...) -> arr[i] via the one-hot mask (exact)."""
+            extra = arr.ndim - 1
+            m = is_popped.reshape((-1,) + (1,) * extra)
+            return jnp.sum(jnp.where(m, arr, 0), axis=0).astype(arr.dtype)
+
+        has_event = jnp.any(st.ev_valid & is_popped)
+        ev_time_i = pick_slot(st.ev_time)
+        ev_t = jnp.maximum(st.now, ev_time_i)
         over_limit = ev_t > time_limit
         active = has_event & ~st.halted & ~over_limit
 
-        kind = st.ev_kind[i]
-        dst = st.ev_node[i]
-        src = st.ev_src[i]
-        args = st.ev_args[i]
+        kind = pick_slot(st.ev_kind)
+        dst = pick_slot(st.ev_node)
+        src = pick_slot(st.ev_src)
+        args = pick_slot(st.ev_args)
+        ev_epoch_i = pick_slot(st.ev_epoch)
+        pay_i = pick_slot(st.ev_pay)
         is_engine = kind < FIRST_USER_KIND
         is_msg = src >= 0
 
+        node_ids = jnp.arange(n, dtype=jnp.int32)
+        dst_oh = node_ids == dst  # (N,) one-hot; all-False for OOB dst
+        state_row = jnp.sum(
+            jnp.where(dst_oh[:, None], st.node_state, 0), axis=0
+        ).astype(jnp.int32)
+        alive_dst = jnp.any(st.alive & dst_oh)
+        paused_dst = jnp.any(st.paused & dst_oh)
+        epoch_dst = jnp.sum(jnp.where(dst_oh, st.epoch, 0)).astype(jnp.int32)
+
         # liveness/epoch gate: user events to a dead or reincarnated node
         # are dropped — the kill-drops-futures semantics of task.rs:255-276
-        live = st.alive[dst] & (st.epoch[dst] == st.ev_epoch[i])
+        live = alive_dst & (epoch_dst == ev_epoch_i)
         # clogged links hold messages; re-check with exponential backoff
         # like the connection pump (net/mod.rs:341-355)
-        clogged = is_msg & st.clog[jnp.maximum(src, 0), dst]
+        src_oh = node_ids == jnp.maximum(src, 0)
+        clogged = is_msg & jnp.any(st.clog & src_oh[:, None] & dst_oh[None, :])
         # paused node: user events are stashed and retried, like the
         # executor stashing a paused node's ready tasks (task.rs:294-314)
-        held = (~is_engine) & st.paused[dst]
+        held = (~is_engine) & paused_dst
         blocked = clogged | held
         dispatch = active & ~blocked & (is_engine | live)
 
@@ -652,7 +586,12 @@ def make_step(wl: Workload, cfg: EngineConfig):
         now_after = jnp.where(dispatch, now + cost, now)
 
         # ---- consume / reschedule the popped slot ----
-        retries = st.ev_retry[i]
+        # All pool updates below are dense (masked selects over the full
+        # pool) rather than scatters: TPU lowers batched scatter to a
+        # serial loop and it measured as 96% of the step wall time
+        # (examples/profile_step.py ablation); the dense forms compute
+        # bit-identical values as pure vector ops.
+        retries = pick_slot(st.ev_retry)
         shift = jnp.minimum(retries, jnp.int32(34)).astype(jnp.int64)
         backoff = jnp.minimum(
             jnp.int64(cfg.clog_backoff_min_ns) << shift,
@@ -660,69 +599,92 @@ def make_step(wl: Workload, cfg: EngineConfig):
         )
         backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
         resched = active & blocked & (is_engine | live)
-        ev_valid = st.ev_valid.at[i].set(resched)
-        ev_time = st.ev_time.at[i].set(jnp.where(resched, now + backoff, st.ev_time[i]))
-        ev_retry = st.ev_retry.at[i].set(jnp.where(resched, retries + 1, retries))
+        ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
+        ev_time_mid = jnp.where(is_popped & resched, now + backoff, st.ev_time)
+        ev_retry_mid = jnp.where(is_popped & resched, retries + 1, st.ev_retry)
 
-        # ---- dispatch ----
-        safe_kind = jnp.clip(kind, 0, n_branches - 1)
+        # ---- dispatch: user handlers via lax.switch; engine kinds are
+        # computed inline as masked selects (see the branch-table note) ----
+        user_idx = jnp.clip(kind - FIRST_USER_KIND, 0, n_user - 1)
         operand = (
-            now, dst, st.node_state[dst], args, src,
-            draw.k0, draw.k1, draw.step, st.ev_pay[i],
+            now, dst, state_row, args, src,
+            draw.k0, draw.k1, draw.step, pay_i,
         )
-        eff = lax.switch(safe_kind, branches, operand)
+        user_state, uem = lax.switch(user_idx, user_branches, operand)
+        user_dispatch = dispatch & ~is_engine
 
-        # ---- apply node-state update ----
-        row = jnp.where(dispatch, eff.node_state, st.node_state[dst])
-        node_state = st.node_state.at[dst].set(row)
+        # ---- apply node-state update (dense; an OOB dst matches no row,
+        # exactly the dropped-scatter semantics) ----
+        row = jnp.where(user_dispatch, user_state, state_row)
+        node_state = jnp.where(dst_oh[:, None], row[None, :], st.node_state)
 
-        # ---- chaos effects: kill / restart / clog ----
-        kill_id = jnp.where(dispatch, eff.kill, jnp.int32(-1))
-        restart_id = jnp.where(dispatch, eff.restart, jnp.int32(-1))
-        node_ids = jnp.arange(n, dtype=jnp.int32)
+        # ---- engine effects: kill / restart / pause / clog / halt ----
+        a0, a1 = args[0], args[1]
+        kill_id = jnp.where(dispatch & (kind == KIND_KILL), a0, jnp.int32(-1))
+        restart_id = jnp.where(dispatch & (kind == KIND_RESTART), a0, jnp.int32(-1))
         is_killed = node_ids == kill_id
         is_restarted = node_ids == restart_id
         alive = jnp.where(is_killed, False, st.alive)
         alive = jnp.where(is_restarted, True, alive)
-        pause_id = jnp.where(dispatch, eff.pause_node, jnp.int32(-1))
-        is_pause_target = node_ids == pause_id
-        paused = jnp.where(
-            is_pause_target, eff.pause_set == 1, st.paused
-        )
+        is_pause_kind = (kind == KIND_PAUSE) | (kind == KIND_RESUME)
+        pause_id = jnp.where(dispatch & is_pause_kind, a0, jnp.int32(-1))
+        paused = jnp.where(node_ids == pause_id, kind == KIND_PAUSE, st.paused)
         # kill/restart clears paused (fresh incarnation runs)
         paused = jnp.where(is_killed | is_restarted, False, paused)
         # epoch bumps invalidate every in-flight event targeting the node
         epoch = st.epoch + is_killed + is_restarted
         node_state = jnp.where(is_restarted[:, None], init_rows, node_state)
 
-        clog_set = jnp.where(dispatch, eff.clog_set, jnp.int32(-1))
+        is_clog_kind = (kind >= KIND_CLOG) & (kind <= KIND_UNCLOG_NODE)
+        clog_on = (kind == KIND_CLOG) | (kind == KIND_CLOG_NODE)
+        clog_set = jnp.where(
+            dispatch & is_clog_kind, clog_on.astype(jnp.int32), jnp.int32(-1)
+        )
+        is_node_clog = (kind == KIND_CLOG_NODE) | (kind == KIND_UNCLOG_NODE)
+        clog_a = a0
+        clog_b = jnp.where(is_node_clog, jnp.int32(-1), a1)
         src_ax = node_ids[:, None]
         dst_ax = node_ids[None, :]
         # clog_link(a, b) blocks both directions; clog_b < 0 means
         # clog_node(a): everything in or out of a (net/mod.rs:157-216)
-        pair_sel = ((src_ax == eff.clog_a) & (dst_ax == eff.clog_b)) | (
-            (src_ax == eff.clog_b) & (dst_ax == eff.clog_a)
+        pair_sel = ((src_ax == clog_a) & (dst_ax == clog_b)) | (
+            (src_ax == clog_b) & (dst_ax == clog_a)
         )
-        node_sel = (eff.clog_b < 0) & (
-            (src_ax == eff.clog_a) | (dst_ax == eff.clog_a)
-        )
+        node_sel = (clog_b < 0) & ((src_ax == clog_a) | (dst_ax == clog_a))
         sel = pair_sel | node_sel
         clog = jnp.where(
             sel & (clog_set == 1), True, jnp.where(sel & (clog_set == 0), False, st.clog)
         )
 
-        halted = st.halted | (dispatch & eff.halt) | (has_event & over_limit)
+        halted = st.halted | (dispatch & (kind == KIND_HALT)) | (has_event & over_limit)
         halt_time = jnp.where(
             (halted & ~st.halted), jnp.minimum(now, time_limit), st.halt_time
         )
 
         # ---- translate emits into pool insertions ----
-        em = eff.emits
-        slot_ix = jnp.arange(k, dtype=jnp.uint32)
-        lat_bits = jax.vmap(lambda s: draw.bits(jnp.uint32(PURPOSE_LATENCY) + s))(
-            slot_ix
+        # user emits are suppressed for engine kinds (the clamped switch
+        # ran *some* user branch); the reborn node's re-init event
+        # (task.rs:279-291) rides an appended timer row — timers never
+        # read their slot's latency/loss draws, so the extra slot is
+        # trace-neutral
+        restart_row = kind == KIND_RESTART
+        em = Emits(
+            valid=jnp.concatenate([uem.valid & ~is_engine, restart_row[None]]),
+            send=jnp.concatenate([uem.send, jnp.zeros((1,), jnp.bool_)]),
+            kind=jnp.concatenate(
+                [uem.kind, jnp.full((1,), FIRST_USER_KIND, jnp.int32)]
+            ),
+            dst=jnp.concatenate([uem.dst, a0[None]]),
+            delay=jnp.concatenate([uem.delay, jnp.zeros((1,), jnp.int64)]),
+            args=jnp.concatenate([uem.args, jnp.zeros((1, 4), jnp.int32)]),
+            pay=jnp.concatenate([uem.pay, jnp.zeros((1, w), jnp.int32)]),
         )
-        loss_bits = jax.vmap(lambda s: draw.bits(jnp.uint32(PURPOSE_LOSS) + s))(slot_ix)
+        slot_ix = jnp.arange(k + 1, dtype=jnp.uint32)  # +1: the restart row
+        # one threefry block per emit slot: lane 0 = latency, lane 1 =
+        # loss (Draw.bits2) — halves the per-step block-cipher count
+        lat_bits, loss_bits = jax.vmap(
+            lambda s: draw.bits2(jnp.uint32(PURPOSE_LATENCY) + s)
+        )(slot_ix)
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
         latency = jnp.int64(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int64)
         # loss_u32 == 2^32 is the static always-drop path (loss_p=1.0);
@@ -735,43 +697,61 @@ def make_step(wl: Workload, cfg: EngineConfig):
         e_valid = dispatch & em.valid & ~lost
         # sends to dead nodes are dropped at send time (socket gone,
         # network.rs:311-313); timers to dead nodes die via the epoch gate
-        e_valid = e_valid & jnp.where(em.send, alive[em.dst], True)
+        emit_dst_oh = em.dst[:, None] == node_ids[None, :]  # (K, N)
+        alive_at_dst = jnp.any(alive[None, :] & emit_dst_oh, axis=1)
+        e_valid = e_valid & jnp.where(em.send, alive_at_dst, True)
         e_time = now_after + jnp.where(em.send, latency, em.delay)
         e_src = jnp.where(em.send, dst, jnp.int32(-1))
-        e_epoch = epoch[em.dst]
+        e_epoch = jnp.sum(
+            jnp.where(emit_dst_oh, epoch[None, :], 0), axis=1
+        ).astype(jnp.int32)
         # engine-kind events bypass the epoch gate; keep their slot epoch 0
         e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
 
-        free = jnp.flatnonzero(~ev_valid, size=k, fill_value=ev_valid.shape[0])
-        # compact: the j-th *valid* emit takes the j-th free slot, so
-        # sparse emit patterns (gated `when` rows) don't waste slots and
-        # only a genuinely full pool drops events
+        # compact placement: the j-th *valid* emit takes the j-th free
+        # slot (pool order), so sparse emit patterns (gated `when` rows)
+        # don't waste slots and only a genuinely full pool drops events.
+        # Dense form: slot j's rank among free slots must equal the
+        # emit's rank among valid emits — an (E, K) match instead of a
+        # flatnonzero + scatter (see the scatter note above).
+        free_rank = jnp.cumsum(~ev_valid_mid) - 1
+        n_free = jnp.sum((~ev_valid_mid).astype(jnp.int32))
         pos = jnp.cumsum(e_valid.astype(jnp.int32)) - 1
-        slot = jnp.where(
-            e_valid,
-            free[jnp.clip(pos, 0, k - 1)],
-            jnp.int32(ev_valid.shape[0]),
-        )
-        dropped = e_valid & (slot >= ev_valid.shape[0])
+        dropped = e_valid & (pos >= n_free)
         overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
         msg_count = st.msg_count + jnp.sum(
             dispatch & em.valid & em.send
         ).astype(jnp.int64)
 
-        ev_valid = ev_valid.at[slot].set(e_valid, mode="drop")
-        ev_time = ev_time.at[slot].set(e_time, mode="drop")
-        ev_kind = st.ev_kind.at[slot].set(em.kind, mode="drop")
-        ev_node = st.ev_node.at[slot].set(em.dst, mode="drop")
-        ev_src = st.ev_src.at[slot].set(e_src, mode="drop")
-        ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
-        ev_retry = ev_retry.at[slot].set(jnp.zeros((k,), jnp.int32), mode="drop")
-        ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
-        ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
+        match = (
+            (~ev_valid_mid)[:, None]
+            & e_valid[None, :]
+            & (free_rank[:, None] == pos[None, :])
+        )  # (E, K); at most one emit matches any slot
+        match_any = jnp.any(match, axis=1)
+
+        def place(vals, mid):
+            """Write each matched emit's value into its slot, else keep mid."""
+            extra = vals.ndim - 1
+            m = match.reshape(match.shape + (1,) * extra)
+            picked = jnp.sum(jnp.where(m, vals[None], 0), axis=1).astype(vals.dtype)
+            keep = match_any.reshape((-1,) + (1,) * extra)
+            return jnp.where(keep, picked, mid)
+
+        ev_valid = ev_valid_mid | match_any
+        ev_time = place(e_time, ev_time_mid)
+        ev_kind = place(em.kind, st.ev_kind)
+        ev_node = place(em.dst, st.ev_node)
+        ev_src = place(e_src, st.ev_src)
+        ev_epoch = place(e_epoch, st.ev_epoch)
+        ev_retry = place(jnp.zeros((k + 1,), jnp.int32), ev_retry_mid)
+        ev_args = place(em.args, st.ev_args)
+        ev_pay = place(em.pay, st.ev_pay)
 
         # ---- trace + clock ----
         trace = jnp.where(
             dispatch,
-            _trace_fold(st.trace, now, kind, dst, args, st.ev_pay[i]),
+            _trace_fold(st.trace, now, kind, dst, args, pay_i),
             st.trace,
         )
         return SimState(

@@ -55,8 +55,12 @@ _PARITY = np.uint32(0x1BD11BDA)
 # purpose, so (seed, step, purpose) uniquely keys every draw in a run.
 PURPOSE_POLL_COST = 0  # 50-100 ns per-event processing cost
 PURPOSE_CLOG_JITTER = 1  # clogged-link recheck jitter
-PURPOSE_LATENCY = 8  # + emit slot  (8 .. 8+K)
-PURPOSE_LOSS = 64  # + emit slot  (64 .. 64+K)
+# per-emit-slot draws: ONE block at PURPOSE_LATENCY+s yields both the
+# latency (lane 0) and loss (lane 1) words via Draw.bits2. PURPOSE_LOSS
+# is reserved/legacy space: the engine no longer draws there, but the
+# range stays unavailable to callers so old and new layouts never alias.
+PURPOSE_LATENCY = 8  # + emit slot  (8 .. 8+K), both lanes used
+PURPOSE_LOSS = 64  # reserved (legacy per-slot loss range)
 PURPOSE_USER = 128  # + user purpose
 
 
@@ -142,6 +146,14 @@ class Draw:
         """32 uniform bits for ``purpose`` (uint32)."""
         a, _ = threefry2x32(self.k0, self.k1, self.step, jnp.uint32(purpose))
         return a
+
+    def bits2(self, purpose):
+        """Both 32-bit lanes of one threefry call — two independent
+        uniform words for the price of one block. The engine pairs the
+        per-emit latency and loss draws this way (latency = lane 0,
+        loss = lane 1 of the PURPOSE_LATENCY+slot counter); the C++
+        oracle mirrors the pairing exactly."""
+        return threefry2x32(self.k0, self.k1, self.step, jnp.uint32(purpose))
 
     def uniform_int(self, lo, hi, purpose):
         """Uniform int64 in [lo, hi).

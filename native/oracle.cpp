@@ -65,6 +65,12 @@ struct Draw {
     threefry2x32(k0, k1, step, purpose, &a, &b);
     return a;
   }
+  // both lanes of one block (engine Draw.bits2): the per-emit latency
+  // (lane 0) and loss (lane 1) draws share the PURPOSE_LATENCY+slot
+  // counter
+  void bits2(uint32_t purpose, uint32_t* a, uint32_t* b) const {
+    threefry2x32(k0, k1, step, purpose, a, b);
+  }
   // uniform int64 in [lo, hi): modulo reduction, same bias as the spec
   int64_t uniform_int(int64_t lo, int64_t hi, uint32_t purpose) const {
     uint32_t span = static_cast<uint32_t>(hi - lo);
@@ -169,6 +175,7 @@ struct Sim {
   std::vector<uint8_t> paused;
   std::vector<int32_t> epoch;
   std::vector<int32_t> node_state;  // (N,U)
+  std::vector<int32_t> init_state;  // (N,U) Workload.initial_state() rows
   std::vector<uint8_t> clog;        // (N,N)
 
   void init() {
@@ -179,7 +186,9 @@ struct Sim {
     alive.assign(wl.n_nodes, 1);
     paused.assign(wl.n_nodes, 0);
     epoch.assign(wl.n_nodes, 0);
-    node_state.assign(static_cast<size_t>(wl.n_nodes) * wl.state_width, 0);
+    if (init_state.empty())
+      init_state.assign(static_cast<size_t>(wl.n_nodes) * wl.state_width, 0);
+    node_state = init_state;
     clog.assign(static_cast<size_t>(wl.n_nodes) * wl.n_nodes, 0);
   }
 
@@ -307,8 +316,11 @@ struct Sim {
     if (restart_id >= 0 && restart_id < wl.n_nodes) {
       alive[restart_id] = 1;
       epoch[restart_id] += 1;
+      // the reborn node restarts from the workload's initial rows, not
+      // zeros (engine: node_state reset to init_rows on restart)
       for (int32_t u = 0; u < wl.state_width; u++)
-        node_state[static_cast<size_t>(restart_id) * wl.state_width + u] = 0;
+        node_state[static_cast<size_t>(restart_id) * wl.state_width + u] =
+            init_state[static_cast<size_t>(restart_id) * wl.state_width + u];
     }
     int32_t pause_id = dispatch ? eff.pause_node : -1;
     if (pause_id >= 0 && pause_id < wl.n_nodes)
@@ -343,8 +355,9 @@ struct Sim {
       if (!ev[j].valid) free.push_back(j);
     for (size_t slot = 0; slot < em.size(); slot++) {
       const Emit& e = em[slot];
-      uint32_t lat_bits = draw.bits(kPurposeLatency + static_cast<uint32_t>(slot));
-      uint32_t loss_bits = draw.bits(kPurposeLoss + static_cast<uint32_t>(slot));
+      uint32_t lat_bits, loss_bits;
+      draw.bits2(kPurposeLatency + static_cast<uint32_t>(slot), &lat_bits,
+                 &loss_bits);
       uint32_t span = static_cast<uint32_t>(cfg.lat_max_ns - cfg.lat_min_ns);
       if (span == 0) span = 1;
       int64_t latency = cfg.lat_min_ns + static_cast<int64_t>(lat_bits % span);

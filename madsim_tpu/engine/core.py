@@ -605,12 +605,16 @@ def make_step(wl: Workload, cfg: EngineConfig):
 
         # ---- dispatch: user handlers via lax.switch; engine kinds are
         # computed inline as masked selects (see the branch-table note) ----
-        user_idx = jnp.clip(kind - FIRST_USER_KIND, 0, n_user - 1)
-        operand = (
-            now, dst, state_row, args, src,
-            draw.k0, draw.k1, draw.step, pay_i,
-        )
-        user_state, uem = lax.switch(user_idx, user_branches, operand)
+        if n_user:
+            user_idx = jnp.clip(kind - FIRST_USER_KIND, 0, n_user - 1)
+            operand = (
+                now, dst, state_row, args, src,
+                draw.k0, draw.k1, draw.step, pay_i,
+            )
+            user_state, uem = lax.switch(user_idx, user_branches, operand)
+        else:
+            # chaos-only workload: no user branches to run
+            user_state, uem = state_row, Emits.none(k, w)
         user_dispatch = dispatch & ~is_engine
 
         # ---- apply node-state update (dense; an OOB dst matches no row,

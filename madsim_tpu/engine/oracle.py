@@ -123,6 +123,13 @@ def run_oracle(
     """Run one seed through the C++ oracle."""
     lib = load()
     set_params(lib, wl, **model_kwargs)
+    # push the workload's initial rows so nonzero init_state (and the
+    # restart-restores-initial-rows path) stays bit-identical
+    init_rows = np.ascontiguousarray(wl.initial_state(), dtype=np.int32)
+    lib.oracle_set_init_state(
+        init_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(init_rows.size),
+    )
     now = ctypes.c_int64()
     trace = ctypes.c_uint64()
     msg_count = ctypes.c_int64()

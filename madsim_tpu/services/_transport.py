@@ -147,13 +147,19 @@ async def serve_requests(
     handler: Callable[[str, dict], Awaitable[Any]],
     error_type: Type[Exception],
     name: str = "service-request",
+    on_bound: Callable[[Any], None] | None = None,
 ) -> None:
     """Server accept loop: each connection carries one (op, kwargs)
     request; the handler's return value (or raised ``error_type``) is
     the reply. Replies are half-closed so they drain through the pump
     before the peer sees EOF. Dual-mode: binds the sim Endpoint inside
-    a simulation, the std TCP Endpoint outside."""
+    a simulation, the std TCP Endpoint outside.
+
+    ``on_bound`` receives the bound local address — bind port 0 and read
+    the real port from it (the flake-free pattern for test servers)."""
     ep = await bind_endpoint(addr)
+    if on_bound is not None:
+        on_bound(ep.local_addr)
     while True:
         tx, rx, _peer = await ep.accept1()
         spawn(_serve_one(tx, rx, handler, error_type), name=name)

@@ -391,6 +391,8 @@ class SimServer:
         await etcd.SimServer(timeout_rate=0.1).serve("0.0.0.0:2379")
     """
 
+    local_addr = None  # set once serving (bind port 0, read it here)
+
     def __init__(self, timeout_rate: float = 0.0):
         self.timeout_rate = timeout_rate
         self._inner = _ServiceInner()
@@ -402,7 +404,10 @@ class SimServer:
 
     async def serve(self, addr: AddrLike) -> None:
         spawn(self._lease_ticker(), name="etcd-lease-ticker")
-        await serve_requests(addr, self._handle, EtcdError, name="etcd-request")
+        await serve_requests(
+            addr, self._handle, EtcdError, name="etcd-request",
+            on_bound=lambda a: setattr(self, "local_addr", a),
+        )
 
     async def _lease_ticker(self) -> None:
         # 1 s lease tick task (service.rs:20-26)

@@ -232,6 +232,8 @@ class Router:
     """Accumulated services + the accept loop
     (transport/server.rs:156-260)."""
 
+    local_addr = None  # set once serving (bind port 0, read it here)
+
     def __init__(self) -> None:
         self._services: dict[str, Any] = {}
 
@@ -249,6 +251,8 @@ class Router:
         """Bind and accept until ``signal`` resolves (server.rs:202-260).
         Each accepted connection carries exactly one call."""
         ep = await bind_endpoint(addr)
+        # bind port 0 and read the real port from here (test de-flaking)
+        self.local_addr = ep.local_addr
         loop = spawn(self._accept_loop(ep), name="grpc-accept-loop")
         if signal is None:
             await loop

@@ -55,6 +55,12 @@ class KafkaError(Exception):
         self.kind = kind
         self.message = message
 
+    def __reduce__(self):
+        # preserve (kind, message) across pickling — the std backend
+        # ships exceptions over real sockets and group-protocol clients
+        # dispatch on .kind (RebalanceInProgress etc.)
+        return (KafkaError, (self.kind, self.message))
+
 
 class BaseRecord:
     """A record to produce. ``partition`` is carried but the broker
@@ -139,20 +145,42 @@ class NewTopic:
 # ---------------------------------------------------------------------------
 
 
+class _Group:
+    """Consumer-group coordinator state (a capability the reference's sim
+    lacks: madsim-rdkafka/src/sim/consumer.rs:110-122 is assign-only)."""
+
+    __slots__ = ("generation", "members", "subs", "assignments", "committed",
+                 "member_seq")
+
+    def __init__(self) -> None:
+        self.generation = 0
+        self.members: dict[str, tuple[int, int]] = {}  # id -> (last_hb_ms, session_ms)
+        self.subs: dict[str, tuple] = {}  # id -> subscribed topics
+        self.assignments: dict[str, list] = {}  # id -> [(topic, partition)]
+        self.committed: dict[tuple[str, int], int] = {}
+        self.member_seq = 0
+
+
 class SimBroker:
     """In-process single broker served over the simulated network:
 
         await kafka.SimBroker().serve("0.0.0.0:9092")
     """
 
+    local_addr = None  # set once serving (bind port 0, read it here)
+
     def __init__(self) -> None:
         # topic -> list of partition logs; each log is a list of Message
         self.topics: dict[str, list[list[Message]]] = {}
         self._rr: dict[str, int] = {}  # round-robin cursor per topic
         self._data_notify = make_notify()
+        self._groups: dict[str, _Group] = {}
 
     async def serve(self, addr: AddrLike) -> None:
-        await serve_requests(addr, self._dispatch, KafkaError, name="kafka-request")
+        await serve_requests(
+            addr, self._dispatch, KafkaError, name="kafka-request",
+            on_bound=lambda a: setattr(self, "local_addr", a),
+        )
 
     async def _dispatch(self, op: str, kw: dict) -> Any:
         if op == "create_topics":
@@ -189,7 +217,110 @@ class SimBroker:
                 )
                 out.append((topic, partition, off))
             return out
+        if op == "join_group":
+            return self._join_group(
+                kw["group"], kw.get("member_id"), kw["topics"], kw["session_ms"]
+            )
+        if op == "sync_group":
+            g = self._group(kw["group"])
+            self._expire(g)
+            mid = kw["member_id"]
+            if mid not in g.members:
+                raise KafkaError("UnknownMemberId", mid)
+            if kw["generation"] != g.generation:
+                raise KafkaError("RebalanceInProgress", kw["group"])
+            return g.assignments.get(mid, [])
+        if op == "heartbeat":
+            g = self._group(kw["group"])
+            mid = kw["member_id"]
+            self._expire(g)
+            if mid not in g.members:
+                raise KafkaError("UnknownMemberId", mid)
+            if kw["generation"] != g.generation:
+                raise KafkaError("RebalanceInProgress", kw["group"])
+            _hb, session = g.members[mid]
+            g.members[mid] = (now_ns() // 1_000_000, session)
+            return True
+        if op == "leave_group":
+            g = self._group(kw["group"])
+            if kw["member_id"] in g.members:
+                del g.members[kw["member_id"]]
+                g.subs.pop(kw["member_id"], None)
+                self._rebalance(g)
+            return True
+        if op == "commit_offsets":
+            # fenced: a zombie (expired or stale-generation) member must
+            # not overwrite the new owner's offsets
+            g = self._group(kw["group"])
+            self._expire(g)
+            mid = kw["member_id"]
+            if mid not in g.members:
+                raise KafkaError("UnknownMemberId", mid)
+            if kw["generation"] != g.generation:
+                raise KafkaError("IllegalGeneration", kw["group"])
+            for topic, partition, off in kw["items"]:
+                g.committed[(topic, partition)] = off
+            return True
+        if op == "fetch_offsets":
+            g = self._group(kw["group"])
+            return [
+                (t, p, g.committed.get((t, p), -1)) for t, p in kw["items"]
+            ]
         raise KafkaError("InvalidOp", op)
+
+    # ---- consumer-group coordination ----------------------------------
+    def _group(self, group_id: str) -> _Group:
+        if group_id not in self._groups:
+            self._groups[group_id] = _Group()
+        return self._groups[group_id]
+
+    def _expire(self, g: _Group) -> None:
+        """Drop members whose session timed out; triggers a rebalance.
+        Lazy (checked on every group op) — deterministic under the
+        simulated clock."""
+        now_ms = now_ns() // 1_000_000
+        dead = [
+            mid for mid, (hb, session) in g.members.items()
+            if now_ms - hb > session
+        ]
+        for mid in dead:
+            del g.members[mid]
+            g.subs.pop(mid, None)
+        if dead:
+            self._rebalance(g)
+
+    def _rebalance(self, g: _Group) -> None:
+        """Round-robin each topic's partitions over the members
+        subscribed to THAT topic; bumps the generation so stale members
+        get RebalanceInProgress on their next heartbeat/sync."""
+        g.generation += 1
+        g.assignments = {m: [] for m in g.members}
+        for topic in sorted({t for sub in g.subs.values() for t in sub}):
+            if topic not in self.topics:
+                continue
+            members_t = sorted(m for m, sub in g.subs.items() if topic in sub)
+            if not members_t:
+                continue
+            for p in range(len(self.topics[topic])):
+                g.assignments[members_t[p % len(members_t)]].append((topic, p))
+
+    def _join_group(self, group_id, member_id, topics, session_ms):
+        g = self._group(group_id)
+        self._expire(g)
+        known = member_id in g.members if member_id else False
+        rejoin_same = known and g.subs.get(member_id) == tuple(topics)
+        if not member_id:
+            g.member_seq += 1
+            member_id = f"member-{g.member_seq}"
+        g.members[member_id] = (now_ns() // 1_000_000, session_ms)
+        g.subs[member_id] = tuple(topics)
+        # only a membership/subscription CHANGE bumps the generation —
+        # a known member re-entering the handshake (its reaction to a
+        # rebalance) must converge on the current generation, otherwise
+        # every rejoin would invalidate every other member forever
+        if not rejoin_same:
+            self._rebalance(g)
+        return (member_id, g.generation)
 
     def _log(self, topic: str, partition: int) -> list[Message]:
         if topic not in self.topics:
@@ -359,9 +490,16 @@ class FutureProducer:
 
 class BaseConsumer:
     """Pull consumer with assign/subscribe + cached fetch
-    (consumer.rs:49-207)."""
+    (consumer.rs:49-207), plus ``group.id`` consumer groups."""
 
     async def close(self) -> None:
+        if self._group and self._member_id:
+            try:
+                await self._raw.call(
+                    "leave_group", group=self._group, member_id=self._member_id
+                )
+            except KafkaError:
+                pass  # broker gone: the session timeout reaps us
         await self._raw.close()
 
     def __init__(self, raw: _Raw, config: ClientConfig):
@@ -372,12 +510,126 @@ class BaseConsumer:
         # (topic, partition) -> next offset
         self._positions: dict[tuple[str, int], int] = {}
         self._cache: list[Message] = []
+        # consumer-group state ("group.id" set => subscribe coordinates
+        # through the broker's group protocol; beats the assign-only
+        # reference sim, consumer.rs:110-122)
+        self._group = config.get("group.id")
+        self._session_ms = int(config.get("session.timeout.ms", "10000"))
+        self._hb_interval_ms = int(config.get("heartbeat.interval.ms", "3000"))
+        self._auto_commit = (
+            config.get("enable.auto.commit", "true").lower() == "true"
+        )
+        self._commit_interval_ms = int(
+            config.get("auto.commit.interval.ms", "5000")
+        )
+        self._member_id: Optional[str] = None
+        self._generation = 0
+        self._sub_topics: tuple = ()
+        self._last_hb_ms = 0
+        self._last_commit_ms = 0
+        # (topic, partition) -> next offset the APP has consumed through
+        # poll(); commits use this, not the fetch position, so messages
+        # cached but never delivered are re-read after a crash
+        # (at-least-once, the librdkafka stored-offset behavior)
+        self._processed: dict[tuple[str, int], int] = {}
 
     async def subscribe(self, topics: Iterable[str]) -> None:
+        """Without ``group.id``: consume every partition (the reference
+        sim's behavior). With ``group.id``: join the consumer group and
+        consume only the partitions the coordinator assigns."""
+        topics = tuple(topics)
+        if self._group:
+            self._sub_topics = topics
+            await self._join_group()
+            return
         for topic in topics:
             meta = await self._raw.call("metadata", topic=topic)
             for p in range(meta[topic]):
                 await self._position_for(topic, p)
+
+    # ---- group membership ---------------------------------------------
+    async def _join_group(self) -> None:
+        while True:
+            self._member_id, self._generation = await self._raw.call(
+                "join_group", group=self._group, member_id=self._member_id,
+                topics=list(self._sub_topics), session_ms=self._session_ms,
+            )
+            try:
+                assignment = await self._raw.call(
+                    "sync_group", group=self._group, member_id=self._member_id,
+                    generation=self._generation,
+                )
+                break
+            except KafkaError as e:
+                # another member joined/left between our join and sync:
+                # re-enter the handshake at the new generation
+                if e.kind not in ("RebalanceInProgress", "UnknownMemberId"):
+                    raise
+                await sleep(0.05)
+        self._cache.clear()
+        self._positions.clear()
+        committed = await self._raw.call(
+            "fetch_offsets", group=self._group,
+            items=[(t, p) for t, p in assignment],
+        )
+        for topic, partition, off in committed:
+            if off >= 0:
+                self._positions[(topic, partition)] = off
+            else:
+                await self._position_for(topic, partition)
+        self._processed = dict(self._positions)
+        self._last_hb_ms = now_ns() // 1_000_000
+
+    def assignment(self) -> list:
+        """The partitions this consumer currently owns."""
+        return sorted(self._positions)
+
+    async def commit(self) -> None:
+        """Commit processed positions to the group coordinator. Fenced
+        by (member_id, generation): a commit from a member the broker
+        has expired or rebalanced past raises UnknownMemberId /
+        IllegalGeneration instead of clobbering the new owner."""
+        if not self._group:
+            raise KafkaError("InvalidConfig", "commit requires group.id")
+        await self._raw.call(
+            "commit_offsets", group=self._group,
+            member_id=self._member_id, generation=self._generation,
+            items=[(t, p, off) for (t, p), off in self._processed.items()],
+        )
+        self._last_commit_ms = now_ns() // 1_000_000
+
+    async def _group_tick(self) -> None:
+        """Heartbeat + auto-commit pacing, driven by poll() the way
+        librdkafka drives its coordinator from the poll loop. A
+        RebalanceInProgress / UnknownMemberId answer re-joins, which
+        picks up the post-rebalance assignment."""
+        now_ms = now_ns() // 1_000_000
+        stale = ("RebalanceInProgress", "UnknownMemberId", "IllegalGeneration")
+        if self._auto_commit and (
+            now_ms - self._last_commit_ms >= self._commit_interval_ms
+        ):
+            try:
+                await self.commit()
+            except KafkaError as e:
+                # fenced: we are a zombie — rejoin rather than clobber
+                # the new owner's offsets (uncommitted progress is
+                # re-delivered: at-least-once)
+                if e.kind not in stale:
+                    raise
+                await self._join_group()
+                return
+        if now_ms - self._last_hb_ms >= self._hb_interval_ms:
+            try:
+                await self._raw.call(
+                    "heartbeat", group=self._group,
+                    member_id=self._member_id, generation=self._generation,
+                )
+                self._last_hb_ms = now_ms
+            except KafkaError as e:
+                if e.kind in stale:
+                    await self._join_group()
+                else:
+                    raise
 
     async def assign(self, tpl: TopicPartitionList) -> None:
         for topic, partition, offset in tpl.items:
@@ -405,8 +657,12 @@ class BaseConsumer:
     async def poll(self) -> Optional[Message]:
         """Next message from cache, fetching when empty
         (poll_internal, consumer.rs:179-207); None when nothing new."""
+        if self._group and self._member_id:
+            await self._group_tick()
         if self._cache:
-            return self._cache.pop(0)
+            m = self._cache.pop(0)
+            self._processed[(m.topic, m.partition)] = m.offset + 1
+            return m
         for (topic, partition), offset in sorted(self._positions.items()):
             r = await self._raw.call(
                 "fetch", topic=topic, partition=partition, offset=offset,
@@ -416,7 +672,9 @@ class BaseConsumer:
             if msgs:
                 self._positions[(topic, partition)] = msgs[-1].offset + 1
                 self._cache.extend(msgs)
-                return self._cache.pop(0)
+                m = self._cache.pop(0)
+                self._processed[(m.topic, m.partition)] = m.offset + 1
+                return m
         return None
 
     async def offsets_for_times(self, items) -> list:

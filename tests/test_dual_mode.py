@@ -1,7 +1,12 @@
 """Dual-mode services: the SAME service classes that run in simulation
 run over real localhost TCP — the reference's cfg-switch drop-in
 contract (madsim-etcd-client/src/lib.rs:1-8; madsim-rdkafka vendors the
-real API for its std build)."""
+real API for its std build).
+
+Every server binds port 0 and the tests read the kernel-assigned port
+from ``server.local_addr`` — no hardcoded ports, safe under parallel
+test runs.
+"""
 
 import asyncio
 
@@ -12,6 +17,18 @@ from madsim_tpu.services import etcd, grpc, kafka
 
 def run(coro):
     return asyncio.run(coro)
+
+
+async def wait_bound(server, task) -> str:
+    """Wait until the server publishes its bound ('ip', port)."""
+    for _ in range(100):
+        if server.local_addr is not None:
+            host, port = server.local_addr
+            return f"127.0.0.1:{port}"
+        if task.done():
+            task.result()  # surface the bind error
+        await asyncio.sleep(0.02)
+    raise TimeoutError("server never bound")
 
 
 class Greeter:
@@ -27,12 +44,11 @@ class Greeter:
 
 def test_greeter_over_real_tcp():
     async def main():
-        server_task = asyncio.create_task(
-            grpc.Server.builder().add_service(Greeter()).serve("127.0.0.1:55061")
-        )
-        await asyncio.sleep(0.2)
+        router = grpc.Server.builder().add_service(Greeter())
+        server_task = asyncio.create_task(router.serve("127.0.0.1:0"))
+        addr = await wait_bound(router, server_task)
         try:
-            ch = await grpc.connect("127.0.0.1:55061")
+            ch = await grpc.connect(addr)
             c = grpc.service_client(Greeter, ch)
             r = await asyncio.wait_for(c.say_hello({"name": "world"}), 10)
             assert r["message"] == "Hello world!"
@@ -52,10 +68,10 @@ def test_greeter_over_real_tcp():
 def test_etcd_kv_over_real_tcp():
     async def main():
         server = etcd.SimServer()
-        server_task = asyncio.create_task(server.serve("127.0.0.1:55062"))
-        await asyncio.sleep(0.2)
+        server_task = asyncio.create_task(server.serve("127.0.0.1:0"))
+        addr = await wait_bound(server, server_task)
         try:
-            c = await etcd.Client.connect(["127.0.0.1:55062"])
+            c = await etcd.Client.connect([addr])
             r1 = await asyncio.wait_for(c.put("k1", "v1"), 10)
             r2 = await asyncio.wait_for(c.put("k1", "v2"), 10)
             assert r2["header_revision"] == r1["header_revision"] + 1
@@ -77,10 +93,10 @@ def test_etcd_kv_over_real_tcp():
 def test_etcd_txn_and_lease_over_real_tcp():
     async def main():
         server = etcd.SimServer()
-        server_task = asyncio.create_task(server.serve("127.0.0.1:55063"))
-        await asyncio.sleep(0.2)
+        server_task = asyncio.create_task(server.serve("127.0.0.1:0"))
+        addr = await wait_bound(server, server_task)
         try:
-            c = await etcd.Client.connect(["127.0.0.1:55063"])
+            c = await etcd.Client.connect([addr])
             await asyncio.wait_for(c.put("k", "1"), 10)
             t = (
                 etcd.Txn()
@@ -114,11 +130,11 @@ def test_etcd_observe_over_real_tcp():
 
     async def main():
         server = etcd.SimServer()
-        server_task = asyncio.create_task(server.serve("127.0.0.1:55065"))
-        await asyncio.sleep(0.2)
+        server_task = asyncio.create_task(server.serve("127.0.0.1:0"))
+        addr = await wait_bound(server, server_task)
         try:
-            c1 = await etcd.Client.connect(["127.0.0.1:55065"])
-            obs = await etcd.Client.connect(["127.0.0.1:55065"])
+            c1 = await etcd.Client.connect([addr])
+            obs = await etcd.Client.connect([addr])
             lease = await asyncio.wait_for(c1.lease_client().grant(ttl=60), 10)
             stream = await obs.election_client().observe("mayor")
             win = await asyncio.wait_for(
@@ -126,7 +142,9 @@ def test_etcd_observe_over_real_tcp():
             )
             first = await asyncio.wait_for(stream.message(), 10)
             assert first["kv"].value == b"alice"
-            await asyncio.wait_for(c1.election_client().proclaim(win["key"], "alice2"), 10)
+            await asyncio.wait_for(
+                c1.election_client().proclaim(win["key"], "alice2"), 10
+            )
             second = await asyncio.wait_for(stream.message(), 10)
             assert second["kv"].value == b"alice2"
             stream.close()
@@ -142,10 +160,10 @@ def test_etcd_observe_over_real_tcp():
 def test_kafka_produce_fetch_over_real_tcp():
     async def main():
         broker = kafka.SimBroker()
-        server_task = asyncio.create_task(broker.serve("127.0.0.1:55064"))
-        await asyncio.sleep(0.2)
+        server_task = asyncio.create_task(broker.serve("127.0.0.1:0"))
+        addr = await wait_bound(broker, server_task)
         try:
-            cfg = kafka.ClientConfig().set("bootstrap.servers", "127.0.0.1:55064")
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
             admin = await cfg.create(kafka.AdminClient)
             await asyncio.wait_for(
                 admin.create_topics([kafka.NewTopic("t", 1)]), 10
@@ -158,7 +176,7 @@ def test_kafka_produce_fetch_over_real_tcp():
                 )
             ccfg = (
                 kafka.ClientConfig()
-                .set("bootstrap.servers", "127.0.0.1:55064")
+                .set("bootstrap.servers", addr)
                 .set("auto.offset.reset", "earliest")
             )
             consumer = await ccfg.create(kafka.BaseConsumer)
@@ -177,6 +195,117 @@ def test_kafka_produce_fetch_over_real_tcp():
             assert sorted(got) == [b"m0", b"m1", b"m2", b"m3", b"m4"]
             for cl in (admin, producer, consumer):
                 await cl.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_kafka_consumer_group_over_real_tcp():
+    """The group protocol (join/sync/heartbeat/rebalance + committed
+    offsets) works over the std backend: two members split partitions;
+    when one leaves, the survivor inherits everything and resumes from
+    the departed member's committed offsets."""
+
+    async def main():
+        broker = kafka.SimBroker()
+        server_task = asyncio.create_task(broker.serve("127.0.0.1:0"))
+        addr = await wait_bound(broker, server_task)
+        try:
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            admin = await cfg.create(kafka.AdminClient)
+            await admin.create_topics([kafka.NewTopic("jobs", 4)])
+            producer = await cfg.create(kafka.FutureProducer)
+            for i in range(20):
+                await producer.send(
+                    kafka.BaseRecord.to("jobs").set_payload(str(i))
+                )
+
+            def ccfg():
+                return (
+                    kafka.ClientConfig()
+                    .set("bootstrap.servers", addr)
+                    .set("group.id", "workers")
+                    .set("auto.offset.reset", "earliest")
+                    .set("session.timeout.ms", "30000")
+                    .set("heartbeat.interval.ms", "100")
+                )
+
+            c1 = await ccfg().create(kafka.BaseConsumer)
+            await c1.subscribe(["jobs"])
+            c2 = await ccfg().create(kafka.BaseConsumer)
+            await c2.subscribe(["jobs"])
+
+            # c1's next poll rejoins at the new generation
+            got1 = []
+            for _ in range(30):
+                m = await asyncio.wait_for(c1.poll(), 10)
+                if m is None:
+                    await asyncio.sleep(0.05)
+                else:
+                    got1.append(int(m.payload))
+            a1, a2 = c1.assignment(), c2.assignment()
+            assert len(a1) == 2 and len(a2) == 2 and not (set(a1) & set(a2))
+
+            await c1.commit()
+            await c1.close()  # leave_group -> immediate rebalance
+
+            got2 = []
+            idle = 0
+            while idle < 30:
+                m = await asyncio.wait_for(c2.poll(), 10)
+                if m is None:
+                    idle += 1
+                    await asyncio.sleep(0.05)
+                else:
+                    idle = 0
+                    got2.append(int(m.payload))
+            assert set(c2.assignment()) == {("jobs", p) for p in range(4)}
+            # everything not consumed (and committed) by c1 reaches c2
+            assert set(got1) | set(got2) == set(range(20))
+            for cl in (admin, producer, c2):
+                await cl.close()
+        finally:
+            server_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_typed_codegen_greeter_over_real_tcp():
+    """Generated message types round-trip over the std backend: the
+    client sends a generated HelloRequest, the server answers with a
+    generated HelloReply, both restored to their classes after real
+    TCP + pickling (madsim-tonic-build typed-stub parity)."""
+    from madsim_tpu.services.grpc_codegen import compile_proto
+
+    ns = compile_proto("examples/proto/helloworld.proto")
+
+    class TypedGreeter(ns.GreeterServicer):
+        async def say_hello(self, request):
+            assert isinstance(request.message, ns.HelloRequest)
+            return ns.HelloReply(message=f"Hello {request.message.name}!")
+
+        async def lots_of_replies(self, request):
+            for i in range(2):
+                yield ns.HelloReply(message=f"#{i} {request.message.name}")
+
+    async def main():
+        router = grpc.Server.builder().add_service(TypedGreeter())
+        server_task = asyncio.create_task(router.serve("127.0.0.1:0"))
+        addr = await wait_bound(router, server_task)
+        try:
+            ch = await grpc.connect(addr)
+            c = ns.GreeterClient(ch)
+            r = await asyncio.wait_for(c.say_hello(ns.HelloRequest(name="tcp")), 10)
+            assert isinstance(r, ns.HelloReply) and r.message == "Hello tcp!"
+            stream = await asyncio.wait_for(
+                c.lots_of_replies(ns.HelloRequest(name="s")), 10
+            )
+            got = [m.message async for m in stream]
+            assert got == ["#0 s", "#1 s"]
+            await ch.close()
         finally:
             server_task.cancel()
         return True

@@ -466,3 +466,41 @@ class TestPauseResume:
         ns = np.asarray(out.node_state)
         assert np.asarray(out.alive)[:, 1].all()
         assert (ns[:, 1, 1] == 1).all(), "post-restart ping delivered"
+
+
+def test_zero_handler_workload_traces():
+    # a chaos-only workload (no user handlers) must still compile: the
+    # user lax.switch is skipped entirely
+    wl = Workload(name="empty", n_nodes=2, state_width=2, handlers=())
+    out = run_workload(wl, EngineConfig(pool_size=16), np.arange(4), 20)
+    assert np.asarray(out.node_state).shape == (4, 2, 2)
+
+
+def test_restart_restores_initial_rows():
+    # restart resets the node to Workload.initial_state(), NOT zeros —
+    # the oracle mirrors this via oracle_set_init_state
+    init_rows = np.asarray([[7, 3], [9, 5]], np.int32)
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        # node 0 schedules: bump own state, then kill+restart node 1
+        eb.after(1_000, user_kind(1), 1, when=ctx.node == jnp.int32(0))
+        eb.after(5_000_000, 0, 0, (1,), when=ctx.node == jnp.int32(0))  # KIND_KILL
+        eb.after(9_000_000, 1, 0, (1,), when=ctx.node == jnp.int32(0))  # KIND_RESTART
+        return ctx.state, eb.build()
+
+    def on_bump(ctx):
+        return ctx.state.at[0].set(ctx.state[0] + 100), ctx.emits().build()
+
+    wl = Workload(
+        name="restart-init", n_nodes=2, state_width=2,
+        handlers=(on_init, on_bump), max_emits=4, init_state=init_rows,
+    )
+    out = run_workload(wl, EngineConfig(pool_size=16), np.arange(4), 60)
+    ns = np.asarray(out.node_state)
+    # node 1 was bumped (7+100 -> wait: node 1 row is [9,5] -> 109),
+    # then killed and restarted: back to its initial row [9, 5]
+    assert (ns[:, 1, 0] == 9).all()
+    assert (ns[:, 1, 1] == 5).all()
+    # node 0 untouched: keeps its initial row
+    assert (ns[:, 0, 0] == 7).all()

@@ -668,3 +668,225 @@ def test_services_deterministic_across_seeds():
 
     assert scenario(42) == scenario(42)
     assert scenario(42) != scenario(43)
+
+
+def test_kafka_consumer_group_splits_partitions():
+    """Two consumers in one group: the coordinator range-assigns the
+    topic's partitions disjointly and every message is consumed exactly
+    once across the group (beats the assign-only reference sim,
+    madsim-rdkafka/src/sim/consumer.rs:110-122)."""
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.4.1").init(serve).build()
+        addr = "10.0.4.1:9092"
+
+        setup = h.create_node().name("setup").ip("10.0.4.2").build()
+
+        async def mk():
+            await ms.sleep(0.1)
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("jobs", 4)])
+            p = await cfg.create(kafka.FutureProducer)
+            for i in range(40):
+                await p.send(kafka.BaseRecord.to("jobs").set_payload(str(i)))
+
+        await setup.spawn(mk())
+
+        def consumer_cfg():
+            return (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("group.id", "workers")
+                .set("auto.offset.reset", "earliest")
+                .set("session.timeout.ms", "5000")
+                .set("heartbeat.interval.ms", "500")
+            )
+
+        async def worker(results):
+            c = await consumer_cfg().create(kafka.BaseConsumer)
+            await c.subscribe(["jobs"])
+            idle = 0
+            while idle < 20:
+                m = await c.poll()
+                if m is None:
+                    idle += 1
+                    await ms.sleep(0.05)
+                else:
+                    idle = 0
+                    results.append((m.partition, int(m.payload)))
+            assign = c.assignment()
+            await c.close()
+            return assign
+
+        n1 = h.create_node().name("c1").ip("10.0.4.3").build()
+        n2 = h.create_node().name("c2").ip("10.0.4.4").build()
+        r1: list = []
+        r2: list = []
+        j1 = n1.spawn(worker(r1))
+        j2 = n2.spawn(worker(r2))
+        a1 = await j1
+        a2 = await j2
+
+        # disjoint assignment covering all 4 partitions, 2 each
+        assert len(a1) == 2 and len(a2) == 2
+        assert not (set(a1) & set(a2))
+        assert set(a1) | set(a2) == {("jobs", p) for p in range(4)}
+        # exactly-once across the group
+        seen = sorted(v for _p, v in r1 + r2)
+        assert seen == list(range(40))
+        assert not ({p for p, _ in r1} & {p for p, _ in r2})
+        return True
+
+    assert run(7, main) is True
+
+
+def test_kafka_consumer_group_rebalances_on_death():
+    """Kill one group member mid-stream: its session times out, the
+    coordinator rebalances, and the survivor picks up the dead member's
+    partitions from the committed offsets — no message lost."""
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.5.1").init(serve).build()
+        addr = "10.0.5.1:9092"
+        setup = h.create_node().name("setup").ip("10.0.5.2").build()
+
+        async def mk():
+            await ms.sleep(0.1)
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("jobs", 4)])
+            p = await cfg.create(kafka.FutureProducer)
+            for i in range(60):
+                await p.send(kafka.BaseRecord.to("jobs").set_payload(str(i)))
+
+        await setup.spawn(mk())
+
+        def consumer_cfg():
+            return (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("group.id", "workers")
+                .set("auto.offset.reset", "earliest")
+                .set("session.timeout.ms", "2000")
+                .set("heartbeat.interval.ms", "300")
+                .set("auto.commit.interval.ms", "200")
+            )
+
+        victim_node = h.create_node().name("victim").ip("10.0.5.3").build()
+        survivor_node = h.create_node().name("survivor").ip("10.0.5.4").build()
+
+        async def victim():
+            c = await consumer_cfg().create(kafka.BaseConsumer)
+            await c.subscribe(["jobs"])
+            got = 0
+            while got < 5:  # consume a few, commit, then get killed
+                m = await c.poll()
+                if m is not None:
+                    got += 1
+                await ms.sleep(0.05)
+            await c.commit()
+            await ms.sleep(1000)  # hang (killed below) without leaving
+
+        async def survivor(results):
+            c = await consumer_cfg().create(kafka.BaseConsumer)
+            await c.subscribe(["jobs"])
+            assert len(c.assignment()) == 2
+            idle = 0
+            while idle < 40:
+                m = await c.poll()
+                if m is None:
+                    idle += 1
+                    await ms.sleep(0.2)
+                else:
+                    idle = 0
+                    results.append(int(m.payload))
+            assign = c.assignment()
+            await c.close()
+            return assign
+
+        victim_node.spawn(victim())
+        results: list = []
+        j = survivor_node.spawn(survivor(results))
+        await ms.sleep(2.0)
+        h.kill(victim_node.id)  # no leave_group: only the session reaps it
+        final_assign = await j
+
+        # after the rebalance the survivor owns all 4 partitions
+        assert set(final_assign) == {("jobs", p) for p in range(4)}
+        # nothing is lost: the survivor's own messages plus re-reading
+        # from the victim's committed offsets cover every payload the
+        # victim did not durably consume
+        assert len(set(results)) >= 60 - 5
+        return True
+
+    assert run(11, main) is True
+
+
+def test_kafka_consumer_group_stabilizes():
+    """After membership stops changing, the generation must converge:
+    a rejoin with unchanged subscriptions does NOT bump the generation
+    (otherwise every rejoin invalidates every other member, forever)."""
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.6.1").init(serve).build()
+        addr = "10.0.6.1:9092"
+        setup = h.create_node().name("setup").ip("10.0.6.2").build()
+
+        async def mk():
+            await ms.sleep(0.1)
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("t", 2)])
+
+        await setup.spawn(mk())
+
+        def ccfg():
+            return (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("group.id", "g")
+                .set("auto.offset.reset", "earliest")
+                .set("heartbeat.interval.ms", "100")
+            )
+
+        async def pair(node_ip, results):
+            c = await ccfg().create(kafka.BaseConsumer)
+            await c.subscribe(["t"])
+            # 30 polls x >= heartbeat interval: plenty of heartbeats
+            for _ in range(30):
+                await c.poll()
+                await ms.sleep(0.15)
+            results.append(c._generation)
+            await c.close()
+
+        n1 = h.create_node().name("c1").ip("10.0.6.3").build()
+        n2 = h.create_node().name("c2").ip("10.0.6.4").build()
+        g1: list = []
+        g2: list = []
+        j1 = n1.spawn(pair("10.0.6.3", g1))
+        j2 = n2.spawn(pair("10.0.6.4", g2))
+        await j1
+        await j2
+        # both settled on the same generation, and it stayed small
+        # (2 joins = 2 bumps; churn would push it to ~30+)
+        assert g1[0] == g2[0], (g1, g2)
+        assert g1[0] <= 3, f"generation churn: {g1[0]}"
+        return True
+
+    assert run(3, main) is True

@@ -83,20 +83,24 @@ def _raw(mod, prefix):
 
 
 def native_transport_bench():
-    """Head-to-head: epoll TCP endpoint vs shm ring, C ABI level."""
+    """Head-to-head: epoll TCP vs io_uring TCP vs shm ring, C ABI level."""
     try:
         from madsim_tpu.std import fastpath
         from madsim_tpu.std import native as native_mod
+        from madsim_tpu.std import uring as uring_mod
     except Exception as e:  # toolchain missing
         print(f"(native transports unavailable: {e})")
         return
     if not (native_mod.available() and fastpath.available()):
         print("(native toolchain unavailable; skipping transport bench)")
         return
-    for label, mod, prefix in (
+    rows = [
         ("epoll-tcp", native_mod, "msep_"),
         ("shm-ring ", fastpath, "shmep_"),
-    ):
+    ]
+    if uring_mod.available():
+        rows.insert(1, ("uring-tcp", uring_mod, "urep_"))
+    for label, mod, prefix in rows:
         bind, send, recv, free, shutdown, dealloc = _raw(mod, prefix)
         pa, pb = ctypes.c_int(0), ctypes.c_int(0)
         a = bind(b"127.0.0.1", 0, ctypes.byref(pa))

@@ -34,6 +34,7 @@ accepted everywhere for hand-rolled services.
 from __future__ import annotations
 
 import dataclasses
+import keyword
 import re
 import types
 from typing import Optional
@@ -210,7 +211,7 @@ def _field_default(type_str: str, label: str, enums: dict):
     return None  # message-typed (or optional): absent until set
 
 
-def _make_message(full_name: str, body: str, enums: dict) -> type:
+def _make_message(full_name: str, body: str, enums: dict, package: str = "") -> type:
     # oneof members are plain fields of the parent in the dataclass view
     while True:
         m = _ONEOF_RE.search(body)
@@ -225,9 +226,19 @@ def _make_message(full_name: str, body: str, enums: dict) -> type:
         label = (fm.group(1) or "").strip()
         type_str = re.sub(r"\s+", "", fm.group(2))
         fname, number = fm.group(3), int(fm.group(4))
+        # Python keywords can't be dataclass fields; suffix them the way
+        # generated code conventionally does (prost escapes as r#from).
+        # __proto_fields__ keeps the original wire name.
+        py_name = fname + "_" if keyword.iskeyword(fname) else fname
         proto_fields.append((fname, number, label or "singular", type_str))
-        fields.append((fname, object, _field_default(type_str, label, enums)))
-    short = full_name.rsplit(".", 1)[-1].replace(".", "_")
+        fields.append((py_name, object, _field_default(type_str, label, enums)))
+    # class name: the in-package path with dots flattened, so nested
+    # messages (shop.Order.Address -> Order_Address) match their
+    # namespace attribute and stay distinguishable across parents
+    rel = full_name
+    if package and full_name.startswith(package + "."):
+        rel = full_name[len(package) + 1:]
+    short = rel.replace(".", "_")
     cls = dataclasses.make_dataclass(
         short,
         fields,
@@ -265,7 +276,7 @@ def _compile_types(src: str, package: str):
     for kind, name, body in blocks:
         if kind == "message":
             full = f"{package}.{name}" if package else name
-            cls = _make_message(full, body, enums)
+            cls = _make_message(full, body, enums, package)
             out.append((name.replace(".", "_"), cls))
     return out
 

@@ -191,6 +191,15 @@ class SimBroker:
                 self.topics[name] = [[] for _ in range(parts)]
                 self._rr[name] = 0
                 created.append(name)
+            # groups already subscribed to a just-created topic pick up
+            # its partitions via a rebalance (the metadata-refresh path
+            # of real brokers); without this an early subscriber would
+            # starve forever
+            for g in self._groups.values():
+                if any(
+                    t in sub for t in created for sub in g.subs.values()
+                ):
+                    self._rebalance(g)
             return created
         if op == "produce":
             return self._produce(kw["records"])

@@ -161,15 +161,32 @@ class ShmEndpoint:
 _LOCAL_IPS = ("127.0.0.1", "localhost", "0.0.0.0", "::1")
 
 
-async def pick_endpoint(addr, *, prefer_shm: Optional[bool] = None):
-    """Bind the fastest transport for ``addr``: the shm ring for
-    loopback/same-host addresses when the native lib builds, else the
-    epoll TCP endpoint — the feature-selection seam of the reference's
-    std/net/mod.rs:33-48."""
+async def pick_endpoint(
+    addr,
+    *,
+    prefer_shm: Optional[bool] = None,
+    prefer_uring: Optional[bool] = None,
+):
+    """Bind the fastest transport for ``addr`` — the feature-selection
+    seam of the reference's std/net/mod.rs:33-48, now with both C28
+    alternative slots filled:
+
+      1. shm ring for loopback/same-host peers (the UCX-style bypass);
+      2. io_uring proactor TCP when the kernel grants a ring (the
+         eRPC-style alternative; cross-host capable, same wire format);
+      3. epoll TCP otherwise.
+
+    ``prefer_shm=False`` with ``prefer_uring=None`` probes io_uring;
+    set ``prefer_uring=False`` to force epoll."""
     host, _ = _split(addr)
     want_shm = prefer_shm if prefer_shm is not None else host in _LOCAL_IPS
     if want_shm and available():
         return await ShmEndpoint.bind(addr)
+    from . import uring
+
+    want_uring = prefer_uring if prefer_uring is not None else True
+    if want_uring and uring.available():
+        return await uring.UringEndpoint.bind(addr)
     from .native import NativeEndpoint
 
     return await NativeEndpoint.bind(addr)

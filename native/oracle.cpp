@@ -902,6 +902,18 @@ void oracle_set_kvchaos(int32_t writes, int32_t n_replicas, int64_t retx_ns,
   g_kv = {writes, n_replicas, retx_ns, client_retx_ns, chaos, payload};
 }
 
+// Initial node-state rows (Workload.initial_state()), flattened (N*U).
+// Passed per run by the Python bridge so nonzero init_state workloads
+// stay bit-identical (init AND restart both restore these rows).
+std::vector<int32_t> g_init_state;
+void oracle_set_init_state(const int32_t* rows, int64_t n) {
+  if (rows == nullptr || n <= 0) {
+    g_init_state.clear();
+  } else {
+    g_init_state.assign(rows, rows + n);
+  }
+}
+
 // Run one seed for n_steps; returns 0 on success. Outputs mirror the
 // SimState fields the trace compare checks.
 int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
@@ -920,6 +932,10 @@ int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
                    clog_backoff_max_ns, time_limit_ns};
   sim.wl = wl;
   sim.seed = seed;
+  if (static_cast<int64_t>(g_init_state.size()) ==
+      static_cast<int64_t>(wl.n_nodes) * wl.state_width) {
+    sim.init_state = g_init_state;
+  }
   sim.init();
   for (int64_t s = 0; s < n_steps; s++) sim.do_step();
   *out_now = sim.now;

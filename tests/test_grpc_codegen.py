@@ -242,3 +242,22 @@ def test_typed_messages_through_sim_grpc():
         return await cli.spawn(go())
 
     assert run(5, main) == "Hello typed!"
+
+
+def test_keyword_field_names_are_escaped():
+    # 'from' etc. can't be dataclass fields; generated code suffixes
+    # them (prost escapes r#from) while __proto_fields__ keeps the wire
+    # name
+    ns = compile_proto_source(
+        "message Transfer { string from = 1; string to = 2; bool in = 3; }"
+    )
+    t = ns.Transfer(from_="a", to="b", in_=True)
+    assert t.from_ == "a" and t.in_ is True
+    names = [f[0] for f in ns.Transfer.__proto_fields__]
+    assert names == ["from", "to", "in"]
+
+
+def test_nested_message_class_names_qualified():
+    ns = compile_proto_source(TYPED_SRC)
+    assert ns.Order_Address.__name__ == "Order_Address"
+    assert ns.Order.__name__ == "Order"

@@ -890,3 +890,57 @@ def test_kafka_consumer_group_stabilizes():
         return True
 
     assert run(3, main) is True
+
+
+def test_kafka_group_picks_up_topic_created_after_subscribe():
+    """Subscribing before the topic exists must not starve the member:
+    topic creation rebalances the groups subscribed to it."""
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await kafka.SimBroker().serve("0.0.0.0:9092")
+
+        h.create_node().name("broker").ip("10.0.7.1").init(serve).build()
+        addr = "10.0.7.1:9092"
+
+        consumer_node = h.create_node().name("c").ip("10.0.7.2").build()
+        admin_node = h.create_node().name("a").ip("10.0.7.3").build()
+
+        async def consume():
+            cfg = (
+                kafka.ClientConfig()
+                .set("bootstrap.servers", addr)
+                .set("group.id", "g")
+                .set("auto.offset.reset", "earliest")
+                .set("heartbeat.interval.ms", "100")
+            )
+            c = await cfg.create(kafka.BaseConsumer)
+            await c.subscribe(["later"])  # topic does not exist yet
+            assert c.assignment() == []
+            got = []
+            for _ in range(60):
+                m = await c.poll()
+                if m is not None:
+                    got.append(int(m.payload))
+                await ms.sleep(0.15)
+            await c.close()
+            return got
+
+        async def create_and_produce():
+            await ms.sleep(1.0)  # consumer subscribed first
+            cfg = kafka.ClientConfig().set("bootstrap.servers", addr)
+            a = await cfg.create(kafka.AdminClient)
+            await a.create_topics([kafka.NewTopic("later", 2)])
+            p = await cfg.create(kafka.FutureProducer)
+            for i in range(6):
+                await p.send(kafka.BaseRecord.to("later").set_payload(str(i)))
+
+        j = consumer_node.spawn(consume())
+        await admin_node.spawn(create_and_produce())
+        got = await j
+        assert sorted(got) == list(range(6)), got
+        return True
+
+    assert run(13, main) is True

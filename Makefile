@@ -1,0 +1,40 @@
+# One-command duality matrix — the analog of the reference's Makefile
+# (reference Makefile:3-22 encodes "build + test under BOTH cfgs"; here
+# the duality is sim vs std, plus the native components and the
+# determinism re-check).
+#
+#   make check   — everything below, in order
+#   make native  — build the C++ components (oracle + 3 transports)
+#   make test    — full suite on the 8-device virtual CPU platform
+#                  (sim tests, dual-mode/std tests, oracle bit-identical
+#                  compare, sharded-equality tests, transports)
+#   make determinism — re-run the runtime suite with the replay checker
+#                  forced on (MADSIM_TEST_CHECK_DETERMINISM=1)
+#   make bench-smoke — one tiny engine measurement + the RPC bench's
+#                  transport head-to-head (exercises sim AND std paths)
+
+PY      ?= python
+TESTENV ?= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: check native test determinism bench-smoke clean
+
+check: native test determinism bench-smoke
+	@echo "== make check: all gates passed =="
+
+native:
+	$(MAKE) -C native
+
+test: native
+	$(TESTENV) $(PY) -m pytest tests/ -q
+
+determinism: native
+	MADSIM_TEST_CHECK_DETERMINISM=1 $(TESTENV) \
+	    $(PY) -m pytest tests/test_runtime.py tests/test_net.py -q
+
+bench-smoke: native
+	BENCH_CHILD=pingpong BENCH_PLATFORM=cpu BENCH_SEEDS=4 BENCH_STEPS=100 \
+	    $(PY) bench.py
+	$(PY) examples/rpc_bench.py
+
+clean:
+	$(MAKE) -C native clean

@@ -42,7 +42,12 @@ CONFIGS = {
     "broadcast": (16384, 500, 48),
     "kvchaos": (4096, 900, 48),
 }
-CPU_SEED_CAP = 2048
+# CPU fallback sizing: seeds are capped by a measured time budget, not a
+# fixed count — a tiny calibration batch estimates per-seed cost and the
+# child picks the largest power-of-two batch fitting CPU_TIME_BUDGET_S,
+# so the fallback artifact still carries scaling information
+CPU_TIME_BUDGET_S = 60.0
+CPU_CALIBRATE_SEEDS = 256
 
 
 def _child_env(platform: str, config: str, n_seeds: int, n_steps: int) -> dict:
@@ -102,15 +107,13 @@ def parent() -> None:
             print(f"# budget exhausted, skipping {config}", file=sys.stderr)
             continue
         timeout = max(90.0, min(per_cfg_cap, remaining))
-        seeds = n_seeds if mode == "default" else min(n_seeds, CPU_SEED_CAP)
-        res = _run_child(mode, config, seeds, n_steps, timeout)
+        res = _run_child(mode, config, n_seeds, n_steps, timeout)
         if res is None and mode == "default":
             # accelerator wedged mid-run: degrade this and later configs
             mode = "cpu"
             platform = "cpu"
-            seeds = min(n_seeds, CPU_SEED_CAP)
             remaining = budget - (time.monotonic() - t_start)
-            res = _run_child("cpu", config, seeds, n_steps, max(90.0, min(per_cfg_cap, remaining)))
+            res = _run_child("cpu", config, n_seeds, n_steps, max(90.0, min(per_cfg_cap, remaining)))
         if res is not None and res.get("error"):
             # a config-level failure (e.g. pool overflow), not a wedge:
             # surface it and move on without degrading the platform
@@ -198,15 +201,37 @@ def child(config: str) -> None:
     init = make_init(wl, cfg)
     run = jax.jit(make_run_while(wl, cfg, n_steps), donate_argnums=0)
 
+    if jax.devices()[0].platform == "cpu" and n_seeds > CPU_CALIBRATE_SEEDS:
+        # time-budgeted fallback sizing: measure a small batch, then run
+        # the largest power-of-two batch that fits the budget (per-seed
+        # cost is ~flat above the calibration size, so this estimate is
+        # conservative)
+        cal_run = jax.jit(make_run_while(wl, cfg, n_steps))
+        jax.block_until_ready(
+            cal_run(init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64)))
+        )  # compile outside the timed window
+        cal = init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64))
+        t0 = time.perf_counter()
+        jax.block_until_ready(cal_run(cal))
+        per_seed = (time.perf_counter() - t0) / CPU_CALIBRATE_SEEDS
+        # the budget covers warm-up + the measured run (2 full passes)
+        fit = int(CPU_TIME_BUDGET_S / 2 / max(per_seed, 1e-9))
+        sized = CPU_CALIBRATE_SEEDS
+        while sized * 2 <= min(fit, n_seeds):
+            sized *= 2
+        n_seeds = sized
+
     state = init(np.arange(n_seeds, dtype=np.uint64))
     jax.block_until_ready(run(state))  # warm-up compile
 
-    # best of 3: the remote-TPU dispatch path has multi-100ms jitter that
-    # dominates these sub-second runs; max throughput is the honest
-    # hardware number (same seeds each repeat — identical work)
+    # best of 3 on the accelerator: the remote-TPU dispatch path has
+    # multi-100ms jitter that dominates these sub-second runs; max
+    # throughput is the honest hardware number (same seeds each repeat —
+    # identical work). CPU has no such jitter: one measured run.
+    repeats = 3 if jax.devices()[0].platform != "cpu" else 1
     wall = float("inf")
     out = None
-    for _ in range(3):
+    for _ in range(repeats):
         state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
         t0 = time.perf_counter()
         o = run(state)

@@ -19,26 +19,18 @@ epoll transport on both empty-RPC latency and 1 MiB payload throughput.
 
 from __future__ import annotations
 
-import asyncio
-import ctypes
-import os
-import pickle
-import subprocess
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Optional
+from typing import Optional
 
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_NATIVE = os.path.join(_REPO, "native")
-_LIB = os.path.join(_NATIVE, "lib", "libshmtransport.so")
+from ._ctypes_ep import make_transport, split_addr
 
 __all__ = ["ShmEndpoint", "available", "build", "pick_endpoint"]
 
-
-def build() -> str:
-    src = os.path.join(_NATIVE, "shm_transport.cpp")
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(src):
-        subprocess.run(["make", "-C", _NATIVE], check=True, capture_output=True)
-    return _LIB
+# wrapper body shared with the epoll and io_uring transports
+# (std/_ctypes_ep.py — identical C ABI shape)
+build, _load, ShmEndpoint = make_transport(
+    "shmep_", "shm_transport.cpp", "libshmtransport.so", "shm"
+)
+ShmEndpoint.__name__ = "ShmEndpoint"
 
 
 def available() -> bool:
@@ -49,113 +41,7 @@ def available() -> bool:
         return False
 
 
-_lib = None
-
-
-def _load() -> ctypes.CDLL:
-    global _lib
-    if _lib is None:
-        lib = ctypes.CDLL(build())
-        lib.shmep_bind.restype = ctypes.c_void_p
-        lib.shmep_bind.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
-        ]
-        lib.shmep_send.restype = ctypes.c_int
-        lib.shmep_send.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.shmep_recv.restype = ctypes.c_void_p
-        lib.shmep_recv.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
-        lib.shmep_msg_len.restype = ctypes.c_uint64
-        lib.shmep_msg_len.argtypes = [ctypes.c_void_p]
-        lib.shmep_msg_data.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.shmep_msg_data.argtypes = [ctypes.c_void_p]
-        lib.shmep_msg_src_ip.restype = ctypes.c_char_p
-        lib.shmep_msg_src_ip.argtypes = [ctypes.c_void_p]
-        lib.shmep_msg_src_port.restype = ctypes.c_int
-        lib.shmep_msg_src_port.argtypes = [ctypes.c_void_p]
-        lib.shmep_msg_free.argtypes = [ctypes.c_void_p]
-        lib.shmep_shutdown.argtypes = [ctypes.c_void_p]
-        lib.shmep_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-    return _lib
-
-
-def _split(addr) -> tuple[str, int]:
-    if isinstance(addr, tuple):
-        return addr[0], int(addr[1])
-    host, port = str(addr).rsplit(":", 1)
-    return host, int(port)
-
-
-class ShmEndpoint:
-    """Tag-matching endpoint over the shared-memory ring, asyncio-friendly."""
-
-    def __init__(self, handle: int, port: int, host: str):
-        self._h = handle
-        self._host = host
-        self._port = port
-        self._pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="shmep-recv"
-        )
-        self._closed = False
-
-    @classmethod
-    async def bind(cls, addr) -> "ShmEndpoint":
-        host, port = _split(addr)
-        lib = _load()
-        out_port = ctypes.c_int(0)
-        h = lib.shmep_bind(host.encode(), port, ctypes.byref(out_port))
-        if not h:
-            raise OSError(f"shm endpoint bind failed for {host}:{port}")
-        return cls(h, out_port.value, host)
-
-    @property
-    def local_addr(self) -> tuple[str, int]:
-        return (self._host, self._port)
-
-    async def send_to(self, dst, tag: int, payload: Any) -> None:
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        if tag >= (1 << 64) - 1 or tag < 0:
-            raise ValueError("tag must fit in 64 bits (top value reserved)")
-        ip, port = _split(dst)
-        raw = pickle.dumps(payload)
-        rc = _load().shmep_send(self._h, ip.encode(), port, tag, raw, len(raw))
-        if rc != 0:
-            raise ConnectionError(f"shm send to {ip}:{port} failed")
-
-    async def recv_from(self, tag: int, timeout: Optional[float] = None):
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        loop = asyncio.get_event_loop()
-        lib = _load()
-        timeout_ms = -1 if timeout is None else max(int(timeout * 1000), 0)
-
-        def blocking():
-            return lib.shmep_recv(self._h, tag, timeout_ms)
-
-        m = await loop.run_in_executor(self._pool, blocking)
-        if not m:
-            if self._closed:
-                raise ConnectionError("endpoint closed during receive")
-            raise asyncio.TimeoutError(f"recv tag {tag} timed out")
-        try:
-            n = lib.shmep_msg_len(m)
-            data = ctypes.string_at(lib.shmep_msg_data(m), n)
-            src = (lib.shmep_msg_src_ip(m).decode(), lib.shmep_msg_src_port(m))
-        finally:
-            lib.shmep_msg_free(m)
-        return pickle.loads(data), src
-
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            lib = _load()
-            lib.shmep_shutdown(self._h)
-            self._pool.shutdown(wait=True)
-            lib.shmep_free(self._h)
+_split = split_addr
 
 
 _LOCAL_IPS = ("127.0.0.1", "localhost", "0.0.0.0", "::1")

@@ -1,4 +1,4 @@
-"""ctypes wrapper for the native C++ transport (native/transport.cpp).
+"""ctypes wrapper for the native C++ epoll transport (native/transport.cpp).
 
 ``NativeEndpoint`` exposes the same tag-matching surface as the asyncio
 backend (std/net.py) on the C++ epoll transport — the native
@@ -7,33 +7,20 @@ real TCP (C26). Both speak the same wire format, so native and asyncio
 endpoints interoperate on the same network (tested in
 tests/test_native_transport.py).
 
-Blocking native receives run on a thread-pool executor so the asyncio
-surface stays non-blocking; payloads are pickled at this layer (the
-transport carries opaque bytes).
+The wrapper body lives in std/_ctypes_ep.py, shared with the shm and
+io_uring transports (identical C ABI shape).
 """
 
 from __future__ import annotations
 
-import asyncio
-import ctypes
-import os
-import pickle
-import subprocess
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Optional
-
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_NATIVE = os.path.join(_REPO, "native")
-_LIB = os.path.join(_NATIVE, "lib", "libmstransport.so")
+from ._ctypes_ep import make_transport
 
 __all__ = ["NativeEndpoint", "available", "build"]
 
-
-def build() -> str:
-    src = os.path.join(_NATIVE, "transport.cpp")
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(src):
-        subprocess.run(["make", "-C", _NATIVE], check=True, capture_output=True)
-    return _LIB
+build, _load, NativeEndpoint = make_transport(
+    "msep_", "transport.cpp", "libmstransport.so", "native"
+)
+NativeEndpoint.__name__ = "NativeEndpoint"
 
 
 def available() -> bool:
@@ -42,116 +29,3 @@ def available() -> bool:
         return True
     except Exception:
         return False
-
-
-_lib = None
-
-
-def _load() -> ctypes.CDLL:
-    global _lib
-    if _lib is None:
-        lib = ctypes.CDLL(build())
-        lib.msep_bind.restype = ctypes.c_void_p
-        lib.msep_bind.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
-        ]
-        lib.msep_send.restype = ctypes.c_int
-        lib.msep_send.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.msep_recv.restype = ctypes.c_void_p
-        lib.msep_recv.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
-        lib.msep_msg_len.restype = ctypes.c_uint64
-        lib.msep_msg_len.argtypes = [ctypes.c_void_p]
-        lib.msep_msg_data.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.msep_msg_data.argtypes = [ctypes.c_void_p]
-        lib.msep_msg_src_ip.restype = ctypes.c_char_p
-        lib.msep_msg_src_ip.argtypes = [ctypes.c_void_p]
-        lib.msep_msg_src_port.restype = ctypes.c_int
-        lib.msep_msg_src_port.argtypes = [ctypes.c_void_p]
-        lib.msep_msg_free.argtypes = [ctypes.c_void_p]
-        lib.msep_shutdown.argtypes = [ctypes.c_void_p]
-        lib.msep_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-    return _lib
-
-
-class NativeEndpoint:
-    """Tag-matching endpoint on the C++ transport, asyncio-friendly."""
-
-    def __init__(self, handle: int, port: int, host: str):
-        self._h = handle
-        self._host = host
-        self._port = port
-        self._pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="msep-recv"
-        )
-        self._closed = False
-
-    @classmethod
-    async def bind(cls, addr) -> "NativeEndpoint":
-        if isinstance(addr, tuple):
-            host, port = addr[0], int(addr[1])
-        else:
-            host, port = str(addr).rsplit(":", 1)
-            port = int(port)
-        lib = _load()
-        out_port = ctypes.c_int(0)
-        h = lib.msep_bind(host.encode(), port, ctypes.byref(out_port))
-        if not h:
-            raise OSError(f"native endpoint bind failed for {host}:{port}")
-        return cls(h, out_port.value, host)
-
-    @property
-    def local_addr(self) -> tuple[str, int]:
-        return (self._host, self._port)
-
-    async def send_to(self, dst, tag: int, payload: Any) -> None:
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        if tag >= (1 << 64) - 1 or tag < 0:
-            raise ValueError("tag 2**64-1 is reserved for the handshake")
-        if isinstance(dst, tuple):
-            ip, port = dst[0], int(dst[1])
-        else:
-            ip, port = str(dst).rsplit(":", 1)
-            port = int(port)
-        raw = pickle.dumps(payload)
-        rc = _load().msep_send(self._h, ip.encode(), port, tag, raw, len(raw))
-        if rc != 0:
-            raise ConnectionError(f"native send to {ip}:{port} failed")
-
-    async def recv_from(self, tag: int, timeout: Optional[float] = None):
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        loop = asyncio.get_event_loop()
-        lib = _load()
-        timeout_ms = -1 if timeout is None else max(int(timeout * 1000), 0)
-
-        def blocking():
-            return lib.msep_recv(self._h, tag, timeout_ms)
-
-        m = await loop.run_in_executor(self._pool, blocking)
-        if not m:
-            if self._closed:
-                raise ConnectionError("endpoint closed during receive")
-            raise asyncio.TimeoutError(f"recv tag {tag} timed out")
-        try:
-            n = lib.msep_msg_len(m)
-            data = ctypes.string_at(lib.msep_msg_data(m), n)
-            src = (lib.msep_msg_src_ip(m).decode(), lib.msep_msg_src_port(m))
-        finally:
-            lib.msep_msg_free(m)
-        return pickle.loads(data), src
-
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            lib = _load()
-            # two-phase: wake every blocked receiver, drain the pool,
-            # then free the native object (freeing earlier would be a
-            # use-after-free under a blocked recv)
-            lib.msep_shutdown(self._h)
-            self._pool.shutdown(wait=True)
-            lib.msep_free(self._h)

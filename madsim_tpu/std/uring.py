@@ -8,66 +8,20 @@ alternatives are the shared-memory ring (same-host) and this io_uring
 proactor endpoint (cross-host capable, same wire format as the epoll
 and asyncio backends, so all four interoperate).
 
-Surface and threading model mirror std/native.py: blocking native
-receives run on a thread-pool executor; payloads are pickled here.
+The wrapper body lives in std/_ctypes_ep.py, shared with the epoll and
+shm transports (identical C ABI shape).
 """
 
 from __future__ import annotations
 
-import asyncio
-import ctypes
-import os
-import pickle
-import subprocess
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Optional
-
-_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_NATIVE = os.path.join(_REPO, "native")
-_LIB = os.path.join(_NATIVE, "lib", "liburingtransport.so")
+from ._ctypes_ep import make_transport
 
 __all__ = ["UringEndpoint", "available", "build"]
 
-
-def build() -> str:
-    src = os.path.join(_NATIVE, "uring_transport.cpp")
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(src):
-        subprocess.run(["make", "-C", _NATIVE], check=True, capture_output=True)
-    return _LIB
-
-
-_lib = None
-
-
-def _load() -> ctypes.CDLL:
-    global _lib
-    if _lib is None:
-        lib = ctypes.CDLL(build())
-        lib.urep_bind.restype = ctypes.c_void_p
-        lib.urep_bind.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int)
-        ]
-        lib.urep_send.restype = ctypes.c_int
-        lib.urep_send.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64,
-            ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.urep_recv.restype = ctypes.c_void_p
-        lib.urep_recv.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
-        lib.urep_msg_len.restype = ctypes.c_uint64
-        lib.urep_msg_len.argtypes = [ctypes.c_void_p]
-        lib.urep_msg_data.restype = ctypes.POINTER(ctypes.c_uint8)
-        lib.urep_msg_data.argtypes = [ctypes.c_void_p]
-        lib.urep_msg_src_ip.restype = ctypes.c_char_p
-        lib.urep_msg_src_ip.argtypes = [ctypes.c_void_p]
-        lib.urep_msg_src_port.restype = ctypes.c_int
-        lib.urep_msg_src_port.argtypes = [ctypes.c_void_p]
-        lib.urep_msg_free.argtypes = [ctypes.c_void_p]
-        lib.urep_shutdown.argtypes = [ctypes.c_void_p]
-        lib.urep_free.argtypes = [ctypes.c_void_p]
-        lib.urep_available.restype = ctypes.c_int
-        _lib = lib
-    return _lib
+build, _load, UringEndpoint = make_transport(
+    "urep_", "uring_transport.cpp", "liburingtransport.so", "io_uring"
+)
+UringEndpoint.__name__ = "UringEndpoint"
 
 
 def available() -> bool:
@@ -76,79 +30,3 @@ def available() -> bool:
         return bool(_load().urep_available())
     except Exception:
         return False
-
-
-def _split(addr) -> tuple[str, int]:
-    if isinstance(addr, tuple):
-        return addr[0], int(addr[1])
-    host, port = str(addr).rsplit(":", 1)
-    return host, int(port)
-
-
-class UringEndpoint:
-    """Tag-matching endpoint on the io_uring proactor, asyncio-friendly."""
-
-    def __init__(self, handle: int, port: int, host: str):
-        self._h = handle
-        self._host = host
-        self._port = port
-        self._pool = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="urep-recv"
-        )
-        self._closed = False
-
-    @classmethod
-    async def bind(cls, addr) -> "UringEndpoint":
-        host, port = _split(addr)
-        lib = _load()
-        out_port = ctypes.c_int(0)
-        h = lib.urep_bind(host.encode(), port, ctypes.byref(out_port))
-        if not h:
-            raise OSError(f"io_uring endpoint bind failed for {host}:{port}")
-        return cls(h, out_port.value, host)
-
-    @property
-    def local_addr(self) -> tuple[str, int]:
-        return (self._host, self._port)
-
-    async def send_to(self, dst, tag: int, payload: Any) -> None:
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        if tag >= (1 << 64) - 1 or tag < 0:
-            raise ValueError("tag must fit in 64 bits (top value reserved)")
-        ip, port = _split(dst)
-        raw = pickle.dumps(payload)
-        rc = _load().urep_send(self._h, ip.encode(), port, tag, raw, len(raw))
-        if rc != 0:
-            raise ConnectionError(f"io_uring send to {ip}:{port} failed")
-
-    async def recv_from(self, tag: int, timeout: Optional[float] = None):
-        if self._closed:
-            raise ConnectionError("endpoint is closed")
-        loop = asyncio.get_event_loop()
-        lib = _load()
-        timeout_ms = -1 if timeout is None else max(int(timeout * 1000), 0)
-
-        def blocking():
-            return lib.urep_recv(self._h, tag, timeout_ms)
-
-        m = await loop.run_in_executor(self._pool, blocking)
-        if not m:
-            if self._closed:
-                raise ConnectionError("endpoint closed during receive")
-            raise asyncio.TimeoutError(f"recv tag {tag} timed out")
-        try:
-            n = lib.urep_msg_len(m)
-            data = ctypes.string_at(lib.urep_msg_data(m), n)
-            src = (lib.urep_msg_src_ip(m).decode(), lib.urep_msg_src_port(m))
-        finally:
-            lib.urep_msg_free(m)
-        return pickle.loads(data), src
-
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            lib = _load()
-            lib.urep_shutdown(self._h)
-            self._pool.shutdown(wait=True)
-            lib.urep_free(self._h)

@@ -137,10 +137,16 @@ struct Uring {
   }
 
   int enter(unsigned wait_nr) {
-    unsigned n = pending;
-    pending = 0;
-    return sys_io_uring_enter(ring_fd, n, wait_nr,
-                              wait_nr ? IORING_ENTER_GETEVENTS : 0);
+    int rc = sys_io_uring_enter(ring_fd, pending, wait_nr,
+                                wait_nr ? IORING_ENTER_GETEVENTS : 0);
+    if (rc >= 0) {
+      // rc = SQEs the kernel consumed; on error (e.g. EBUSY under CQ
+      // pressure) everything stays staged and the next enter retries
+      pending -= (static_cast<unsigned>(rc) < pending
+                      ? static_cast<unsigned>(rc)
+                      : pending);
+    }
+    return rc;
   }
 
   bool peek_cqe(io_uring_cqe** out) {
@@ -299,7 +305,9 @@ struct Endpoint {
   }
 
   uint64_t wake_buf = 0;
+  bool wake_inflight = false;
   void submit_wake_read() {
+    if (wake_inflight) return;
     io_uring_sqe* s = sqe_or_flush();
     if (!s) return;
     s->opcode = IORING_OP_READ;
@@ -307,6 +315,7 @@ struct Endpoint {
     s->addr = reinterpret_cast<uint64_t>(&wake_buf);
     s->len = 8;
     s->user_data = make_ud(kOpWake, wake_fd);
+    wake_inflight = true;
   }
 
   void submit_recv_locked(Conn& c) {
@@ -404,6 +413,7 @@ struct Endpoint {
         int res = cqe->res;
         ring.seen();
         if (op == kOpWake) {
+          wake_inflight = false;
           if (closed) return;
           submit_wake_read();
           // kicked: new outbound conns to watch / fresh bytes to write
@@ -453,6 +463,15 @@ struct Endpoint {
         }
       }
       if (closed) return;
+      // self-heal sweep: a submit_* that found the SQ full (or an
+      // enter() that failed) dropped its SQE silently; nothing else
+      // retries, so re-arm anything missing each wakeup
+      if (!accept_inflight) submit_accept();
+      if (!wake_inflight) submit_wake_read();
+      for (auto& [cfd, c] : conns) {
+        submit_recv_locked(c);
+        submit_write_locked(c);
+      }
     }
   }
 

@@ -472,19 +472,35 @@ def _trace_fold(trace, now, kind, node, args, pay=None):
     return trace * _TRACE_PRIME + h
 
 
-def make_step(wl: Workload, cfg: EngineConfig):
+def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
     Pops the earliest pending event, dispatches it through
     ``lax.switch`` (engine kinds + user handlers), applies chaos effects,
-    and scatter-inserts emitted events. ``jax.vmap`` over the seed axis
-    and ``lax.scan`` over steps give the batched run loop.
+    and inserts emitted events. ``jax.vmap`` over the seed axis and
+    ``lax.scan`` over steps give the batched run loop.
+
+    ``layout`` picks the *lowering* of the per-event reads/writes — the
+    VALUES are bit-identical either way (the oracle suite asserts it):
+
+    * ``"dense"`` — one-hot masked reductions and rank-match placement,
+      no gather/scatter ops. TPU lowers batched scatter/gather to
+      serial loops (measured 96% of step wall time,
+      examples/profile_step.py), so dense is ~70x faster there.
+    * ``"scatter"`` — dynamic indexing and ``.at[].set`` scatters, the
+      natural (and faster) lowering on CPU.
+    * ``None`` (default) — scatter on the CPU backend, dense elsewhere.
     """
     n = wl.n_nodes
     k = wl.max_emits
     w = wl.payload_words
     init_rows = jnp.asarray(wl.initial_state())
     n_user = len(wl.handlers)
+    if layout is None:
+        layout = "scatter" if jax.default_backend() == "cpu" else "dense"
+    if layout not in ("dense", "scatter"):
+        raise ValueError(f"unknown layout {layout!r}")
+    dense = layout == "dense"
 
     # -- user branch table -------------------------------------------------
     # Only USER handlers go through lax.switch; engine kinds (kill, clog,
@@ -524,23 +540,30 @@ def make_step(wl: Workload, cfg: EngineConfig):
     def step(st: SimState) -> SimState:
         # ---- pop the earliest pending event (the timer-jump of
         # time/mod.rs:45-60 merged with the ready-queue drain) ----
-        # Per-seed dynamic indexing (arr[i], arr[dst]) lowers to batched
-        # gathers under vmap, which measured ~1 ms/step on TPU
-        # (examples/profile_step.py). Every read below is instead a
-        # one-hot masked reduction over the small E or N axis — pure
-        # vector ALU work, bit-identical values. This also matches the
-        # oracle's out-of-range handling exactly (no gather clamping).
+        # Two value-identical lowerings of every per-event read/write
+        # (see the ``layout`` docstring): dense = one-hot masked
+        # reductions over the small E or N axis (per-seed dynamic
+        # indexing lowers to batched gathers under vmap, ~1 ms/step on
+        # TPU, examples/profile_step.py); scatter = plain indexing with
+        # in_range masks so OOB handling matches dense and the oracle.
         e_slots = st.ev_valid.shape[0]
         tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
         i = jnp.argmin(tmask)
         slot_ids = jnp.arange(e_slots, dtype=jnp.int32)
         is_popped = slot_ids == i.astype(jnp.int32)
 
-        def pick_slot(arr):
-            """arr (E, ...) -> arr[i] via the one-hot mask (exact)."""
-            extra = arr.ndim - 1
-            m = is_popped.reshape((-1,) + (1,) * extra)
-            return jnp.sum(jnp.where(m, arr, 0), axis=0).astype(arr.dtype)
+        if dense:
+
+            def pick_slot(arr):
+                """arr (E, ...) -> arr[i] via the one-hot mask (exact)."""
+                extra = arr.ndim - 1
+                m = is_popped.reshape((-1,) + (1,) * extra)
+                return jnp.sum(jnp.where(m, arr, 0), axis=0).astype(arr.dtype)
+
+        else:
+
+            def pick_slot(arr):
+                return arr[i]
 
         has_event = jnp.any(st.ev_valid & is_popped)
         ev_time_i = pick_slot(st.ev_time)
@@ -559,20 +582,37 @@ def make_step(wl: Workload, cfg: EngineConfig):
 
         node_ids = jnp.arange(n, dtype=jnp.int32)
         dst_oh = node_ids == dst  # (N,) one-hot; all-False for OOB dst
-        state_row = jnp.sum(
-            jnp.where(dst_oh[:, None], st.node_state, 0), axis=0
-        ).astype(jnp.int32)
-        alive_dst = jnp.any(st.alive & dst_oh)
-        paused_dst = jnp.any(st.paused & dst_oh)
-        epoch_dst = jnp.sum(jnp.where(dst_oh, st.epoch, 0)).astype(jnp.int32)
+        if dense:
+            state_row = jnp.sum(
+                jnp.where(dst_oh[:, None], st.node_state, 0), axis=0
+            ).astype(jnp.int32)
+            alive_dst = jnp.any(st.alive & dst_oh)
+            paused_dst = jnp.any(st.paused & dst_oh)
+            epoch_dst = jnp.sum(jnp.where(dst_oh, st.epoch, 0)).astype(jnp.int32)
+        else:
+            # gather lowering. Gathers clamp out-of-range indices, which
+            # would silently diverge from the dense form's no-match (and
+            # the oracle); mask with in_range so an OOB dst reads as a
+            # dead node with zero state in BOTH layouts
+            in_range = (dst >= 0) & (dst < n)
+            dst_c = jnp.clip(dst, 0, n - 1)
+            state_row = jnp.where(in_range, st.node_state[dst_c], 0)
+            alive_dst = st.alive[dst_c] & in_range
+            paused_dst = st.paused[dst_c] & in_range
+            epoch_dst = jnp.where(in_range, st.epoch[dst_c], 0)
 
         # liveness/epoch gate: user events to a dead or reincarnated node
         # are dropped — the kill-drops-futures semantics of task.rs:255-276
         live = alive_dst & (epoch_dst == ev_epoch_i)
         # clogged links hold messages; re-check with exponential backoff
         # like the connection pump (net/mod.rs:341-355)
-        src_oh = node_ids == jnp.maximum(src, 0)
-        clogged = is_msg & jnp.any(st.clog & src_oh[:, None] & dst_oh[None, :])
+        if dense:
+            src_oh = node_ids == jnp.maximum(src, 0)
+            clogged = is_msg & jnp.any(
+                st.clog & src_oh[:, None] & dst_oh[None, :]
+            )
+        else:
+            clogged = is_msg & st.clog[jnp.maximum(src, 0), dst_c] & in_range
         # paused node: user events are stashed and retried, like the
         # executor stashing a paused node's ready tasks (task.rs:294-314)
         held = (~is_engine) & paused_dst
@@ -586,11 +626,10 @@ def make_step(wl: Workload, cfg: EngineConfig):
         now_after = jnp.where(dispatch, now + cost, now)
 
         # ---- consume / reschedule the popped slot ----
-        # All pool updates below are dense (masked selects over the full
-        # pool) rather than scatters: TPU lowers batched scatter to a
-        # serial loop and it measured as 96% of the step wall time
-        # (examples/profile_step.py ablation); the dense forms compute
-        # bit-identical values as pure vector ops.
+        # dense: masked selects over the full pool (TPU lowers batched
+        # scatter to a serial loop — it measured as 96% of step wall
+        # time, examples/profile_step.py); scatter: .at[].set, the
+        # faster CPU lowering. Same values either way.
         retries = pick_slot(st.ev_retry)
         shift = jnp.minimum(retries, jnp.int32(34)).astype(jnp.int64)
         backoff = jnp.minimum(
@@ -599,9 +638,18 @@ def make_step(wl: Workload, cfg: EngineConfig):
         )
         backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
         resched = active & blocked & (is_engine | live)
-        ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
-        ev_time_mid = jnp.where(is_popped & resched, now + backoff, st.ev_time)
-        ev_retry_mid = jnp.where(is_popped & resched, retries + 1, st.ev_retry)
+        if dense:
+            ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
+            ev_time_mid = jnp.where(is_popped & resched, now + backoff, st.ev_time)
+            ev_retry_mid = jnp.where(is_popped & resched, retries + 1, st.ev_retry)
+        else:
+            ev_valid_mid = st.ev_valid.at[i].set(resched)
+            ev_time_mid = st.ev_time.at[i].set(
+                jnp.where(resched, now + backoff, ev_time_i)
+            )
+            ev_retry_mid = st.ev_retry.at[i].set(
+                jnp.where(resched, retries + 1, retries)
+            )
 
         # ---- dispatch: user handlers via lax.switch; engine kinds are
         # computed inline as masked selects (see the branch-table note) ----
@@ -617,10 +665,17 @@ def make_step(wl: Workload, cfg: EngineConfig):
             user_state, uem = state_row, Emits.none(k, w)
         user_dispatch = dispatch & ~is_engine
 
-        # ---- apply node-state update (dense; an OOB dst matches no row,
-        # exactly the dropped-scatter semantics) ----
+        # ---- apply node-state update (an OOB dst matches no row in the
+        # dense form, exactly the dropped-scatter semantics) ----
         row = jnp.where(user_dispatch, user_state, state_row)
-        node_state = jnp.where(dst_oh[:, None], row[None, :], st.node_state)
+        if dense:
+            node_state = jnp.where(dst_oh[:, None], row[None, :], st.node_state)
+        else:
+            # negative indices would wrap (numpy semantics); redirect OOB
+            # to index n so mode="drop" discards it like dense's no-match
+            node_state = st.node_state.at[
+                jnp.where(in_range, dst_c, jnp.int32(n))
+            ].set(row, mode="drop")
 
         # ---- engine effects: kill / restart / pause / clog / halt ----
         a0, a1 = args[0], args[1]
@@ -701,56 +756,85 @@ def make_step(wl: Workload, cfg: EngineConfig):
         e_valid = dispatch & em.valid & ~lost
         # sends to dead nodes are dropped at send time (socket gone,
         # network.rs:311-313); timers to dead nodes die via the epoch gate
-        emit_dst_oh = em.dst[:, None] == node_ids[None, :]  # (K, N)
-        alive_at_dst = jnp.any(alive[None, :] & emit_dst_oh, axis=1)
+        if dense:
+            emit_dst_oh = em.dst[:, None] == node_ids[None, :]  # (K+1, N)
+            alive_at_dst = jnp.any(alive[None, :] & emit_dst_oh, axis=1)
+            e_epoch = jnp.sum(
+                jnp.where(emit_dst_oh, epoch[None, :], 0), axis=1
+            ).astype(jnp.int32)
+        else:
+            em_in_range = (em.dst >= 0) & (em.dst < n)
+            em_dst_c = jnp.clip(em.dst, 0, n - 1)
+            alive_at_dst = alive[em_dst_c] & em_in_range
+            e_epoch = jnp.where(em_in_range, epoch[em_dst_c], 0)
         e_valid = e_valid & jnp.where(em.send, alive_at_dst, True)
         e_time = now_after + jnp.where(em.send, latency, em.delay)
         e_src = jnp.where(em.send, dst, jnp.int32(-1))
-        e_epoch = jnp.sum(
-            jnp.where(emit_dst_oh, epoch[None, :], 0), axis=1
-        ).astype(jnp.int32)
         # engine-kind events bypass the epoch gate; keep their slot epoch 0
         e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
 
         # compact placement: the j-th *valid* emit takes the j-th free
         # slot (pool order), so sparse emit patterns (gated `when` rows)
         # don't waste slots and only a genuinely full pool drops events.
-        # Dense form: slot j's rank among free slots must equal the
-        # emit's rank among valid emits — an (E, K) match instead of a
-        # flatnonzero + scatter (see the scatter note above).
-        free_rank = jnp.cumsum(~ev_valid_mid) - 1
-        n_free = jnp.sum((~ev_valid_mid).astype(jnp.int32))
         pos = jnp.cumsum(e_valid.astype(jnp.int32)) - 1
-        dropped = e_valid & (pos >= n_free)
-        overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
         msg_count = st.msg_count + jnp.sum(
             dispatch & em.valid & em.send
         ).astype(jnp.int64)
+        k1 = k + 1  # user slots + the restart row
 
-        match = (
-            (~ev_valid_mid)[:, None]
-            & e_valid[None, :]
-            & (free_rank[:, None] == pos[None, :])
-        )  # (E, K); at most one emit matches any slot
-        match_any = jnp.any(match, axis=1)
+        if dense:
+            # slot j's rank among free slots must equal the emit's rank
+            # among valid emits — an (E, K+1) match instead of a
+            # flatnonzero + scatter (see the scatter note above)
+            free_rank = jnp.cumsum(~ev_valid_mid) - 1
+            n_free = jnp.sum((~ev_valid_mid).astype(jnp.int32))
+            dropped = e_valid & (pos >= n_free)
+            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
 
-        def place(vals, mid):
-            """Write each matched emit's value into its slot, else keep mid."""
-            extra = vals.ndim - 1
-            m = match.reshape(match.shape + (1,) * extra)
-            picked = jnp.sum(jnp.where(m, vals[None], 0), axis=1).astype(vals.dtype)
-            keep = match_any.reshape((-1,) + (1,) * extra)
-            return jnp.where(keep, picked, mid)
+            match = (
+                (~ev_valid_mid)[:, None]
+                & e_valid[None, :]
+                & (free_rank[:, None] == pos[None, :])
+            )  # (E, K+1); at most one emit matches any slot
+            match_any = jnp.any(match, axis=1)
 
-        ev_valid = ev_valid_mid | match_any
-        ev_time = place(e_time, ev_time_mid)
-        ev_kind = place(em.kind, st.ev_kind)
-        ev_node = place(em.dst, st.ev_node)
-        ev_src = place(e_src, st.ev_src)
-        ev_epoch = place(e_epoch, st.ev_epoch)
-        ev_retry = place(jnp.zeros((k + 1,), jnp.int32), ev_retry_mid)
-        ev_args = place(em.args, st.ev_args)
-        ev_pay = place(em.pay, st.ev_pay)
+            def place(vals, mid):
+                """Write each matched emit's value into its slot."""
+                extra = vals.ndim - 1
+                m = match.reshape(match.shape + (1,) * extra)
+                picked = jnp.sum(
+                    jnp.where(m, vals[None], 0), axis=1
+                ).astype(vals.dtype)
+                keep = match_any.reshape((-1,) + (1,) * extra)
+                return jnp.where(keep, picked, mid)
+
+            ev_valid = ev_valid_mid | match_any
+            ev_time = place(e_time, ev_time_mid)
+            ev_kind = place(em.kind, st.ev_kind)
+            ev_node = place(em.dst, st.ev_node)
+            ev_src = place(e_src, st.ev_src)
+            ev_epoch = place(e_epoch, st.ev_epoch)
+            ev_retry = place(jnp.zeros((k1,), jnp.int32), ev_retry_mid)
+            ev_args = place(em.args, st.ev_args)
+            ev_pay = place(em.pay, st.ev_pay)
+        else:
+            free = jnp.flatnonzero(~ev_valid_mid, size=k1, fill_value=e_slots)
+            slot = jnp.where(
+                e_valid, free[jnp.clip(pos, 0, k1 - 1)], jnp.int32(e_slots)
+            )
+            dropped = e_valid & (slot >= e_slots)
+            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
+            ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
+            ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
+            ev_kind = st.ev_kind.at[slot].set(em.kind, mode="drop")
+            ev_node = st.ev_node.at[slot].set(em.dst, mode="drop")
+            ev_src = st.ev_src.at[slot].set(e_src, mode="drop")
+            ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
+            ev_retry = ev_retry_mid.at[slot].set(
+                jnp.zeros((k1,), jnp.int32), mode="drop"
+            )
+            ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
+            ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
 
         # ---- trace + clock ----
         trace = jnp.where(
@@ -786,7 +870,7 @@ def make_step(wl: Workload, cfg: EngineConfig):
     return step
 
 
-def make_run(wl: Workload, cfg: EngineConfig, n_steps: int):
+def make_run(wl: Workload, cfg: EngineConfig, n_steps: int, layout: str | None = None):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
     The returned function is jit-friendly and sharding-friendly: every
@@ -794,7 +878,7 @@ def make_run(wl: Workload, cfg: EngineConfig, n_steps: int):
     axis turns this into pure data-parallel work across chips with zero
     collectives in the hot loop (results are combined host-side).
     """
-    step = jax.vmap(make_step(wl, cfg))
+    step = jax.vmap(make_step(wl, cfg, layout))
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -806,7 +890,9 @@ def make_run(wl: Workload, cfg: EngineConfig, n_steps: int):
     return run
 
 
-def make_run_while(wl: Workload, cfg: EngineConfig, max_steps: int):
+def make_run_while(
+    wl: Workload, cfg: EngineConfig, max_steps: int, layout: str | None = None
+):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
     ``lax.while_loop`` on device: no wasted lockstep iterations once the
@@ -816,7 +902,7 @@ def make_run_while(wl: Workload, cfg: EngineConfig, max_steps: int):
     all-halted reduction runs per iteration; with a sharded seed axis it
     is XLA's only collective in the loop (a cheap scalar all-reduce).
     """
-    step = jax.vmap(make_step(wl, cfg))
+    step = jax.vmap(make_step(wl, cfg, layout))
 
     def run(state: SimState) -> SimState:
         def cond(carry):

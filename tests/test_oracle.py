@@ -32,14 +32,14 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def engine_batch(wl, cfg, seeds, n_steps):
+def engine_batch(wl, cfg, seeds, n_steps, layout=None):
     init = make_init(wl, cfg)
-    run = jax.jit(make_run(wl, cfg, n_steps))
+    run = jax.jit(make_run(wl, cfg, n_steps, layout=layout))
     return run(init(np.asarray(seeds, np.uint64)))
 
 
-def compare(wl, cfg, seeds, n_steps, **model_kwargs):
-    out = engine_batch(wl, cfg, seeds, n_steps)
+def compare(wl, cfg, seeds, n_steps, layout=None, **model_kwargs):
+    out = engine_batch(wl, cfg, seeds, n_steps, layout=layout)
     for idx, seed in enumerate(seeds):
         o = run_oracle(wl, cfg, seed, n_steps, **model_kwargs)
         assert int(out.trace[idx]) == o.trace, (
@@ -81,10 +81,13 @@ def test_microbench_traces_bit_identical():
     compare(wl, cfg, list(range(8)), 220, rounds=200)
 
 
-def test_raft_traces_bit_identical():
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_raft_traces_bit_identical(layout):
+    # both lowerings of the step (the TPU dense form and the CPU scatter
+    # form) must match the oracle bit-for-bit
     wl = make_raft()
     cfg = EngineConfig(pool_size=128, loss_p=0.05)
-    compare(wl, cfg, list(range(16)), 400)
+    compare(wl, cfg, list(range(16)), 400, layout=layout)
 
 
 def test_raft_with_time_limit_bit_identical():
@@ -93,11 +96,14 @@ def test_raft_with_time_limit_bit_identical():
     compare(wl, cfg, [3, 9, 27], 400)
 
 
-def test_broadcast_traces_bit_identical():
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_broadcast_traces_bit_identical(layout):
     # partition chaos + packet loss: the clog/unclog + retransmit path
+    # (the only oracle workload exercising the clogged-reschedule
+    # branch, so both lowerings must run it)
     wl = make_broadcast(rounds=3)
     cfg = EngineConfig(pool_size=128, loss_p=0.05)
-    compare(wl, cfg, list(range(12)), 400, rounds=3)
+    compare(wl, cfg, list(range(12)), 400, layout=layout, rounds=3)
 
 
 def test_broadcast_no_partition_bit_identical():
@@ -113,12 +119,13 @@ def test_kvchaos_traces_bit_identical():
     compare(wl, cfg, list(range(12)), 500, writes=5)
 
 
-def test_kvchaos_payload_traces_bit_identical():
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_kvchaos_payload_traces_bit_identical(layout):
     # the payload arena: client-drawn value words ride WRITE/REPL events
     # and feed the trace hash — a payload divergence anywhere fails here
     wl = make_kvchaos(writes=5, payload=True)
     cfg = EngineConfig(pool_size=128, loss_p=0.02)
-    compare(wl, cfg, list(range(12)), 500, writes=5)
+    compare(wl, cfg, list(range(12)), 500, layout=layout, writes=5)
 
 
 def test_kvchaos_payload_no_chaos_bit_identical():

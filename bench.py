@@ -42,6 +42,9 @@ CONFIGS = {
     "broadcast": (16384, 500, 48),
     "kvchaos": (4096, 900, 48),
 }
+# BASELINE.md config 1 specifies the single-seed pingpong on the CPU sim
+# runtime — a lone seed cannot amortize accelerator dispatch overhead
+CPU_ONLY_CONFIGS = {"pingpong"}
 # CPU fallback sizing: seeds are capped by a measured time budget, not a
 # fixed count — a tiny calibration batch estimates per-seed cost and the
 # child picks the largest power-of-two batch fitting CPU_TIME_BUDGET_S,
@@ -107,8 +110,9 @@ def parent() -> None:
             print(f"# budget exhausted, skipping {config}", file=sys.stderr)
             continue
         timeout = max(90.0, min(per_cfg_cap, remaining))
-        res = _run_child(mode, config, n_seeds, n_steps, timeout)
-        if res is None and mode == "default":
+        cfg_mode = "cpu" if config in CPU_ONLY_CONFIGS else mode
+        res = _run_child(cfg_mode, config, n_seeds, n_steps, timeout)
+        if res is None and cfg_mode == "default":
             # accelerator wedged mid-run: degrade this and later configs
             mode = "cpu"
             platform = "cpu"

@@ -44,6 +44,7 @@ from .core import (  # noqa: E402,F401
 from .verify import check_determinism, compare_traces  # noqa: E402,F401
 from .checkpoint import load as load_checkpoint  # noqa: E402,F401
 from .checkpoint import save as save_checkpoint  # noqa: E402,F401
+from .search import SearchReport, search_seeds  # noqa: E402,F401
 from .rng import (  # noqa: E402,F401
     Draw,
     chance_threshold,

@@ -1,0 +1,160 @@
+"""Batched chaos-schedule search: hunt seeds that violate an invariant.
+
+The reference's multi-seed runner executes ``MADSIM_TEST_NUM`` seeds and
+prints a repro banner for the first failure (reference
+madsim/src/sim/runtime/builder.rs:110-148, runtime/mod.rs:193-200). At
+engine scale the same idea becomes a *search*: sweep tens of thousands
+of seeded chaos schedules in one batched run (BASELINE.md config 5 —
+"4,096-seed chaos schedule search") and report every seed whose final
+state breaks a user invariant, each with the exact repro recipe.
+
+    report = search_seeds(
+        wl, cfg,
+        invariant=lambda view: view["node_state"][:, 0, 0] >= 1,
+        n_seeds=4096, max_steps=900,
+    )
+    report.failing_seeds  # -> np.ndarray of violating seeds
+    report.banner()       # -> repro lines, seed + config hash each
+
+The invariant is a host-side predicate over the final batched state
+(numpy views), returning a boolean array over the seed axis — True =
+invariant holds. Deterministic by construction: re-running any failing
+seed (alone or in any batch) reproduces the identical trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Mapping
+
+import numpy as np
+
+import jax
+
+from .core import EngineConfig, Workload, make_init, make_run_while
+
+__all__ = ["SearchReport", "search_seeds"]
+
+# compiled-run cache: repeated searches over the same (workload, config,
+# step budget, layout) — the tool's own repro workflow — reuse the XLA
+# program instead of re-tracing per call (jit's cache keys on function
+# identity, so a fresh closure per call would defeat it)
+_RUN_CACHE: dict = {}
+
+
+def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout):
+    key = (id(wl), cfg.hash(), max_steps, layout)
+    if key not in _RUN_CACHE:
+        _RUN_CACHE[key] = (
+            make_init(wl, cfg),
+            jax.jit(make_run_while(wl, cfg, max_steps, layout=layout)),
+            wl,  # keep the workload alive so id() stays unique
+        )
+    return _RUN_CACHE[key]
+
+
+@dataclasses.dataclass
+class SearchReport:
+    """Outcome of one batched invariant sweep."""
+
+    workload: str
+    config_hash: str
+    seeds: np.ndarray  # every seed searched
+    ok: np.ndarray  # (S,) bool — invariant held
+    halted: np.ndarray  # (S,) bool
+    overflowed: np.ndarray  # (S,) bool — event-pool drops: verdict unreliable
+    traces: np.ndarray  # (S,) uint64 — per-seed trace hashes
+    steps: int  # engine steps the sweep ran
+
+    @property
+    def failing_seeds(self) -> np.ndarray:
+        """Violations on seeds whose simulation was trustworthy (no
+        pool overflow — see :attr:`overflowed_seeds`)."""
+        return self.seeds[~self.ok & ~self.overflowed]
+
+    @property
+    def unhalted_seeds(self) -> np.ndarray:
+        """Seeds still running at max_steps — schedules the step budget
+        could not finish (raise max_steps or treat as liveness bugs)."""
+        return self.seeds[~self.halted]
+
+    @property
+    def overflowed_seeds(self) -> np.ndarray:
+        """Seeds whose event pool dropped events: their verdicts are
+        simulator artifacts, not evidence — raise ``cfg.pool_size``
+        and re-search (the same rule bench.py applies to its metric)."""
+        return self.seeds[self.overflowed]
+
+    def banner(self, limit: int = 10) -> str:
+        """Repro recipe per failing seed (runtime/mod.rs:193-200 shape)."""
+        bad = self.failing_seeds
+        lines = [
+            f"chaos search over {len(self.seeds)} seeds of "
+            f"{self.workload!r}: {len(bad)} violation(s)",
+        ]
+        if self.overflowed.any():
+            lines.append(
+                f"  WARNING: {int(self.overflowed.sum())} seed(s) "
+                f"overflowed the event pool; excluded (raise pool_size)"
+            )
+        for s in bad[:limit]:
+            lines.append(
+                f"  seed {int(s)}: rerun with seeds=[{int(s)}] "
+                f"config_hash={self.config_hash}"
+            )
+        if len(bad) > limit:
+            lines.append(f"  ... and {len(bad) - limit} more")
+        return "\n".join(lines)
+
+
+def _state_view(out) -> Mapping[str, np.ndarray]:
+    """Host-side numpy views of EVERY final-state field, keyed by name
+    (the checkpoint.py pattern) — invariants can reach anything,
+    including paused/clog chaos state and the raw event pool."""
+    return {
+        f.name: np.asarray(getattr(out, f.name))
+        for f in dataclasses.fields(out)
+    }
+
+
+def search_seeds(
+    wl: Workload,
+    cfg: EngineConfig,
+    invariant: Callable[[Mapping[str, np.ndarray]], np.ndarray],
+    n_seeds: int = 4096,
+    max_steps: int = 1000,
+    seed_base: int = 0,
+    require_halt: bool = True,
+    layout: str | None = None,
+) -> SearchReport:
+    """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
+    final states.
+
+    ``require_halt=True`` (default) additionally counts a seed that
+    never halts within ``max_steps`` as a violation — an unfinished
+    schedule means the scenario's goal condition was never reached,
+    which is exactly the liveness bug a chaos search is hunting.
+    """
+    seeds = np.arange(seed_base, seed_base + n_seeds, dtype=np.uint64)
+    init, run, _ = _compiled_run(wl, cfg, max_steps, layout)
+    out = jax.block_until_ready(run(init(seeds)))
+    view = _state_view(out)
+    ok = np.asarray(invariant(view), dtype=bool)
+    if ok.shape != (n_seeds,):
+        raise ValueError(
+            f"invariant must return a ({n_seeds},) boolean array, "
+            f"got shape {ok.shape}"
+        )
+    halted = view["halted"]
+    if require_halt:
+        ok = ok & halted
+    return SearchReport(
+        workload=wl.name,
+        config_hash=cfg.hash(),
+        seeds=seeds,
+        ok=ok,
+        halted=halted,
+        overflowed=view["overflow"] > 0,
+        traces=view["trace"],
+        steps=int(np.asarray(out.step).max()),
+    )

@@ -69,7 +69,7 @@ def scan_n(body):
 
 def main():
     wl = make_raft()
-    cfg = EngineConfig(pool_size=128, loss_p=0.02)
+    cfg = EngineConfig(pool_size=48, loss_p=0.02)
     k = wl.max_emits
     init = make_init(wl, cfg)
     state = init(np.arange(N_SEEDS, dtype=np.uint64))

@@ -31,16 +31,20 @@ TARGET = 200_000.0  # BASELINE.json north star, sim_s/s
 # name -> (n_seeds, max_steps, pool_size). Steps are run_while caps; the
 # runner exits as soon as every seed halts. CPU-fallback seed counts are
 # capped so a wedged-tunnel round still finishes within budget.
-# pool sizes: every workload's peak in-flight event count measured < 32
-# with zero overflow and traces identical to pool 128 (pool only changes
-# behavior on overflow); 48 leaves headroom for tail seeds while keeping
-# the (S, E) state arrays — the step's memory-traffic term — small
+# The workload factories, engine configs (pool sizes: every workload's
+# peak in-flight event count measured < 32 with zero overflow and traces
+# identical to pool 128; 48 leaves headroom while keeping the (S, E)
+# state arrays small), seed counts and step caps live in
+# madsim_tpu.models.BENCH_SPECS, shared with the cross-backend
+# determinism artifact (examples/cross_backend_check.py). This mirror
+# keeps the parent process jax-free (the resilience contract above):
+#   name -> (n_seeds, max_steps)
 CONFIGS = {
-    "raft": (65536, 600, 48),
-    "microbench": (1024, 1100, 32),
-    "pingpong": (1, 300, 32),
-    "broadcast": (16384, 500, 48),
-    "kvchaos": (4096, 900, 48),
+    "raft": (65536, 600),
+    "microbench": (1024, 1100),
+    "pingpong": (1, 300),
+    "broadcast": (16384, 500),
+    "kvchaos": (4096, 900),
 }
 # BASELINE.md config 1 specifies the single-seed pingpong on the CPU sim
 # runtime — a lone seed cannot amortize accelerator dispatch overhead
@@ -104,7 +108,7 @@ def parent() -> None:
     print(f"# probe: mode={mode} platform={platform}", file=sys.stderr)
 
     results = {}
-    for config, (n_seeds, n_steps, _pool) in CONFIGS.items():
+    for config, (n_seeds, n_steps) in CONFIGS.items():
         remaining = budget - (time.monotonic() - t_start)
         if remaining < 60 and results:
             print(f"# budget exhausted, skipping {config}", file=sys.stderr)
@@ -177,30 +181,14 @@ def child(config: str) -> None:
     import numpy as np
 
     from madsim_tpu.engine import EngineConfig, make_init, make_run_while
-    from madsim_tpu.models import (
-        make_broadcast,
-        make_kvchaos,
-        make_microbench,
-        make_pingpong,
-        make_raft,
-    )
+    from madsim_tpu.models import BENCH_SPECS
 
     n_seeds = int(os.environ.get("BENCH_SEEDS", "8192"))
     n_steps = int(os.environ.get("BENCH_STEPS", "600"))
-    pool = CONFIGS[config][2]
-
-    if config == "raft":
-        wl, cfg = make_raft(), EngineConfig(pool_size=pool, loss_p=0.02)
-    elif config == "microbench":
-        wl, cfg = make_microbench(), EngineConfig(pool_size=pool)
-    elif config == "pingpong":
-        wl, cfg = make_pingpong(), EngineConfig(pool_size=pool)
-    elif config == "broadcast":
-        wl, cfg = make_broadcast(), EngineConfig(pool_size=pool, loss_p=0.05)
-    elif config == "kvchaos":
-        wl, cfg = make_kvchaos(), EngineConfig(pool_size=pool, loss_p=0.02)
-    else:
+    if config not in BENCH_SPECS:
         raise SystemExit(f"unknown config {config}")
+    factory, cfg_kwargs, _spec_seeds, _spec_steps = BENCH_SPECS[config]
+    wl, cfg = factory(), EngineConfig(**cfg_kwargs)
 
     init = make_init(wl, cfg)
     run = jax.jit(make_run_while(wl, cfg, n_steps), donate_argnums=0)

@@ -1,0 +1,121 @@
+"""CPU-vs-TPU bit-identical trace check on real silicon.
+
+SURVEY.md §4's build implication (d): the TPU-native analog of the
+reference's determinism checker is a cross-backend trace compare —
+the same seeds run on the CPU backend (scatter layout) and the
+accelerator (dense layout) must produce identical uint64 trace hashes,
+clocks, and final node state. This script runs it for every benchmark
+workload and writes the committed artifact (CROSS_BACKEND.json).
+
+Zero divergence is the BASELINE.json "trace-divergence rate" metric.
+
+Usage: python examples/cross_backend_check.py [n_seeds] [out.json]
+(run it WITHOUT JAX_PLATFORMS so the accelerator is visible; the CPU
+half runs in a subprocess pinned to the cpu backend)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+FIELDS = ("trace", "now", "halted", "halt_time", "msg_count", "overflow")
+
+
+def run_half(platform: str, n_seeds: int) -> dict:
+    """Run every config on one backend in a subprocess; return arrays."""
+    env = dict(os.environ)
+    env["CROSS_CHILD"] = "1"
+    env["CROSS_SEEDS"] = str(n_seeds)
+    env["CROSS_PLATFORM"] = platform
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"{platform} half failed: {proc.stderr[-800:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def child() -> None:
+    import jax
+
+    if os.environ.get("CROSS_PLATFORM") == "cpu":
+        # the env var alone is not enough: this image's sitecustomize
+        # pins JAX_PLATFORMS to the TPU plugin at interpreter startup
+        # (see tests/conftest.py); the config update wins
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from madsim_tpu.engine import EngineConfig, make_init, make_run
+    from madsim_tpu.models import BENCH_SPECS
+
+    n_seeds = int(os.environ["CROSS_SEEDS"])
+    seeds = np.arange(n_seeds, dtype=np.uint64)
+    out = {"platform": jax.devices()[0].platform, "configs": {}}
+    # the SAME configurations the benchmark reports (shared table), so
+    # this artifact certifies exactly what bench.py measures; step caps
+    # trimmed where the workload halts far earlier
+    step_cap = {"raft": 400, "broadcast": 400, "kvchaos": 700}
+    for name, (factory, cfg_kwargs, _seeds, spec_steps) in BENCH_SPECS.items():
+        wl, cfg = factory(), EngineConfig(**cfg_kwargs)
+        run = jax.jit(make_run(wl, cfg, step_cap.get(name, spec_steps)))
+        res = jax.block_until_ready(run(make_init(wl, cfg)(seeds)))
+        out["configs"][name] = {
+            f: np.asarray(getattr(res, f)).astype(np.uint64).tolist()
+            if f == "trace"
+            else np.asarray(getattr(res, f)).astype(np.int64).tolist()
+            for f in FIELDS
+        }
+    print(json.dumps(out))
+
+
+def main() -> None:
+    if os.environ.get("CROSS_CHILD"):
+        child()
+        return
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    out_path = sys.argv[2] if len(sys.argv) > 2 else "CROSS_BACKEND.json"
+    acc = run_half("default", n_seeds)
+    cpu = run_half("cpu", n_seeds)
+    if acc["platform"] == "cpu" or cpu["platform"] != "cpu":
+        # comparing a backend against itself proves nothing — refuse to
+        # write a vacuous artifact
+        raise SystemExit(
+            f"not a cross-backend run: accel={acc['platform']} "
+            f"cpu={cpu['platform']} (is the accelerator visible?)"
+        )
+    report = {
+        "accel_platform": acc["platform"],
+        "cpu_platform": cpu["platform"],
+        "n_seeds": n_seeds,
+        "configs": {},
+        "divergences": 0,
+    }
+    for name in acc["configs"]:
+        diverged = []
+        for f in FIELDS:
+            a, c = acc["configs"][name][f], cpu["configs"][name][f]
+            n_bad = sum(1 for x, y in zip(a, c) if x != y)
+            if n_bad:
+                diverged.append((f, n_bad))
+        report["configs"][name] = {
+            "identical": not diverged,
+            "diverged_fields": diverged,
+        }
+        report["divergences"] += sum(n for _f, n in diverged)
+        status = "IDENTICAL" if not diverged else f"DIVERGED {diverged}"
+        print(f"{name}: {status}", file=sys.stderr)
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps({"divergence_rate": report["divergences"],
+                      "accel": acc["platform"], "n_seeds": n_seeds}))
+    if report["divergences"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,3 +18,15 @@ from .pingpong import make_pingpong  # noqa: F401
 from .broadcast import make_broadcast  # noqa: F401
 from .raft import make_raft  # noqa: F401
 from .kvchaos import make_kvchaos  # noqa: F401
+
+# The BASELINE.md benchmark configurations, shared by bench.py and
+# examples/cross_backend_check.py so the cross-backend determinism
+# artifact certifies exactly the configuration the benchmark reports:
+#   name -> (factory, engine-config kwargs, bench seed count, step cap)
+BENCH_SPECS = {
+    "raft": (make_raft, dict(pool_size=48, loss_p=0.02), 65536, 600),
+    "microbench": (make_microbench, dict(pool_size=32), 1024, 1100),
+    "pingpong": (make_pingpong, dict(pool_size=32), 1, 300),
+    "broadcast": (make_broadcast, dict(pool_size=48, loss_p=0.05), 16384, 500),
+    "kvchaos": (make_kvchaos, dict(pool_size=48, loss_p=0.02), 4096, 900),
+}

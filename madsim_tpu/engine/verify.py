@@ -17,7 +17,7 @@ import jax
 from ..runtime.rand import DeterminismError
 from .core import EngineConfig, Workload, make_init, make_run
 
-__all__ = ["check_determinism", "compare_traces"]
+__all__ = ["check_determinism", "check_layouts", "compare_traces"]
 
 
 def compare_traces(a, b, what: str = "run") -> None:
@@ -52,3 +52,38 @@ def check_determinism(
     a = run(init(seeds))
     b = run(init(seeds))
     compare_traces(a, b, what=f"{wl.name} x2")
+
+
+def check_layouts(
+    wl: Workload, cfg: EngineConfig, seeds, n_steps: int
+) -> None:
+    """Run the workload through BOTH step lowerings (dense and scatter,
+    see make_step's ``layout``) and raise on any trace divergence.
+
+    The library form of the cross-backend determinism check
+    (examples/cross_backend_check.py runs it across real silicon): the
+    two lowerings are the same program in different clothes, so any
+    difference is an engine bug, typically an out-of-range index whose
+    gather/scatter semantics diverge from the dense masks.
+    """
+    seeds = np.asarray(seeds, np.uint64)
+    init = make_init(wl, cfg)
+    dense = jax.jit(make_run(wl, cfg, n_steps, layout="dense"))(init(seeds))
+    scatter = jax.jit(make_run(wl, cfg, n_steps, layout="scatter"))(init(seeds))
+    compare_traces(dense, scatter, what=f"{wl.name} dense-vs-scatter")
+    # the trace doesn't see everything (dropped-on-overflow events, a
+    # mis-masked state write after the last fold): compare the same
+    # field set the cross-backend artifact checks, plus the node state
+    for field in ("now", "halted", "halt_time", "msg_count", "overflow",
+                  "node_state", "ev_valid"):
+        da = np.asarray(getattr(dense, field))
+        sa = np.asarray(getattr(scatter, field))
+        if not np.array_equal(da, sa):
+            seed_idx = np.nonzero(
+                (da != sa).reshape(da.shape[0], -1).any(axis=1)
+            )[0][0]
+            raise DeterminismError(
+                f"{wl.name} dense-vs-scatter: field {field!r} diverged "
+                f"at seed index {int(seed_idx)} "
+                f"(seed {int(seeds[seed_idx])})"
+            )

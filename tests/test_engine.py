@@ -504,3 +504,15 @@ def test_restart_restores_initial_rows():
     assert (ns[:, 1, 1] == 5).all()
     # node 0 untouched: keeps its initial row
     assert (ns[:, 0, 0] == 7).all()
+
+
+@pytest.mark.parametrize("name", ["raft", "microbench", "pingpong",
+                                  "broadcast", "kvchaos"])
+def test_check_layouts_all_models(name):
+    # the library form of the cross-backend check: dense and scatter
+    # lowerings must agree (traces + state) for every benchmark workload
+    from madsim_tpu.engine import EngineConfig, check_layouts
+    from madsim_tpu.models import BENCH_SPECS
+
+    factory, cfg_kwargs, _seeds, _steps = BENCH_SPECS[name]
+    check_layouts(factory(), EngineConfig(**cfg_kwargs), np.arange(8), 150)

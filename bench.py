@@ -216,11 +216,11 @@ def child(config: str) -> None:
     state = init(np.arange(n_seeds, dtype=np.uint64))
     jax.block_until_ready(run(state))  # warm-up compile
 
-    # best of 3 on the accelerator: the remote-TPU dispatch path has
+    # best of 5 on the accelerator: the remote-TPU dispatch path has
     # multi-100ms jitter that dominates these sub-second runs; max
     # throughput is the honest hardware number (same seeds each repeat —
     # identical work). CPU has no such jitter: one measured run.
-    repeats = 3 if jax.devices()[0].platform != "cpu" else 1
+    repeats = 5 if jax.devices()[0].platform != "cpu" else 1
     wall = float("inf")
     out = None
     for _ in range(repeats):

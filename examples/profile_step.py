@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from madsim_tpu.engine import EngineConfig, make_init, make_run, make_step
+from madsim_tpu.engine import EngineConfig, make_init, make_step
 from madsim_tpu.engine.core import _INF_NS
 from madsim_tpu.engine.rng import PURPOSE_LATENCY, PURPOSE_POLL_COST, Draw
 from madsim_tpu.models import make_raft

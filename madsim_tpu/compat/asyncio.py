@@ -24,11 +24,10 @@ from __future__ import annotations
 
 import asyncio as _real
 import heapq
-from typing import Any, Coroutine, Iterable, Optional
+from typing import Any, Coroutine, Optional
 
 from ..runtime import context
 from ..runtime.future import SimFuture
-from ..runtime.task import JoinError
 from ..sync import Notify
 from ..sync import Semaphore as _SimSemaphore
 

@@ -52,7 +52,7 @@ replay checker, runtime/mod.rs:165-190).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Sequence
+from typing import Callable
 
 import numpy as np
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 from collections import deque
 from typing import Any, Optional
 
-from ..runtime import context
 from ..runtime.future import SimFuture
 from ..runtime.plugin import node as current_node
 from .addr import AddrLike, SocketAddr, parse_addr

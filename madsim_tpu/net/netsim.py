@@ -18,14 +18,14 @@ Parity with reference madsim/src/sim/net/mod.rs:
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable, Optional
+from typing import Callable
 
 from ..runtime import context
 from ..runtime.future import SimFuture
 from ..runtime.plugin import Simulator
 from ..runtime.time_ import NANOS_PER_SEC
 from .addr import SocketAddr
-from .network import Network, Protocols, Stat
+from .network import Network, Stat
 
 __all__ = ["NetSim", "Pipe", "PipeSender", "PipeReceiver"]
 

@@ -19,7 +19,7 @@ arrays over a seed axis.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Protocol
+from typing import Optional, Protocol
 
 from ..runtime.config import NetConfig
 from ..runtime.rand import GlobalRng

@@ -20,7 +20,7 @@ The reference additionally interposes libc ``getrandom``/``getentropy``
 from __future__ import annotations
 
 import random as _pyrandom
-from typing import Callable, Iterable, MutableSequence, Sequence, TypeVar
+from typing import Callable, MutableSequence, Sequence, TypeVar
 
 T = TypeVar("T")
 

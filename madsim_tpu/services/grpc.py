@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import enum
 import inspect
-from typing import Any, AsyncIterator, Callable, Optional
+from typing import Any, Callable, Optional
 
 from ..net.addr import AddrLike, SocketAddr, parse_addr
 from ..runtime.future import SimFuture

@@ -31,6 +31,7 @@ WORKLOAD_IDS = {
     "broadcast": 3,
     "kvchaos": 4,
     "kvchaos-payload": 4,  # same C++ workload; payload flag via set_params
+    "twophase": 5,
 }
 
 _lib = None
@@ -103,6 +104,14 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
             ctypes.c_int64(model_kwargs.get("retx_ns", 50_000_000)),
             ctypes.c_int32(1 if model_kwargs.get("partition", True) else 0),
+        )
+    elif wl.name == "twophase":
+        lib.oracle_set_twophase(
+            ctypes.c_int32(model_kwargs.get("txns", 5)),
+            ctypes.c_int32(model_kwargs.get("n_parts", 4)),
+            ctypes.c_int32(model_kwargs.get("no_pct", 10)),
+            ctypes.c_int64(model_kwargs.get("retx_ns", 40_000_000)),
+            ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
         )
     elif wl.name in ("kvchaos", "kvchaos-payload"):
         lib.oracle_set_kvchaos(

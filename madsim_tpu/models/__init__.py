@@ -2,8 +2,9 @@
 
 Each module builds a :class:`madsim_tpu.engine.Workload`: per-node int32
 state plus pure event handlers, the state-machine form in which user
-programs enter the XLA-compiled step function. These four cover the
-benchmark configs in BASELINE.md:
+programs enter the XLA-compiled step function. Every one has a
+bit-identical C++ oracle implementation (native/oracle.cpp). The first
+five cover the benchmark configs in BASELINE.md:
 
   1. pingpong    — 3-node ping-pong RPC (tonic-example shape)
   2. microbench  — single-node timer+rand loop (no network)
@@ -11,6 +12,8 @@ benchmark configs in BASELINE.md:
   4. raft        — 5-node leader election (the north-star workload)
   5. kvchaos     — replicated KV cluster with kill/restart chaos and a
                    majority-durability invariant
+  6. twophase    — two-phase commit with stored votes, phase-aware
+                   retransmits and participant crash/recovery
 """
 
 from .microbench import make_microbench  # noqa: F401
@@ -18,6 +21,7 @@ from .pingpong import make_pingpong  # noqa: F401
 from .broadcast import make_broadcast  # noqa: F401
 from .raft import make_raft  # noqa: F401
 from .kvchaos import make_kvchaos  # noqa: F401
+from .twophase import make_twophase  # noqa: F401
 
 # The BASELINE.md benchmark configurations, shared by bench.py and
 # examples/cross_backend_check.py so the cross-backend determinism

@@ -1,0 +1,240 @@
+"""Two-phase commit under chaos: atomicity with votes, retries, crashes.
+
+A coordinator (node 0) drives ``txns`` transactions over ``n_parts``
+participants: PREPARE -> votes (each participant decides once per
+transaction, seeded, and re-sends its STORED vote on retransmit) ->
+COMMIT when every vote is yes / ABORT on the first no -> acks. Packet
+loss and a scheduled participant kill/restart (the engine KILL/RESTART
+chaos events) exercise every retry path; the retransmit loop re-sends
+whichever phase's messages are missing.
+
+Recovery: a reborn participant (on_init runs again after RESTART)
+announces itself with HELLO, retried until it has seen any traffic;
+the coordinator clears the reborn node's vote/ack bit for the current
+transaction so the retransmit loop re-covers it — without this, a
+participant that acked the final decision and then crashed+restarted
+before completion would never be re-sent the decision (its ack bit is
+already set) and would halt ignorant of it.
+
+Halt condition: every transaction decided AND the final decision acked
+by every participant. Invariants the tests / chaos search check at
+halt: the coordinator's commit+abort tally equals ``txns``, every
+participant applied the final transaction's decision, and every
+participant's stored decision VALUE agrees with the coordinator's
+(atomicity: nobody committed what another aborted).
+
+Coordinator state: [cur_txn, phase(0=prepare 1=commit 2=abort),
+                    votes_mask, ack_mask, n_commit, n_abort]
+Participant state: [last_prepared, my_vote, last_decided, n_applied,
+                    last_decision_value]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+
+COORD = 0
+
+_H_INIT = 0
+_H_PREPARE = 1  # at participant: args = (txn,)
+_H_VOTE = 2  # at coordinator: args = (txn, part, yes)
+_H_DECISION = 3  # at participant: args = (txn, commit)
+_H_ACK = 4  # at coordinator: args = (txn, part)
+_H_RETX = 5  # at coordinator: args = (txn,)
+_H_HELLO = 6  # at coordinator: args = (part,) — a (re)born participant
+_H_HRETX = 7  # at participant: retry HELLO until any traffic seen
+
+# user draw purposes
+_P_VOTE = 0
+_P_KILL_AT = 1
+_P_KILL_WHO = 2
+_P_REVIVE = 3
+
+
+def make_twophase(
+    txns: int = 5,
+    n_parts: int = 4,
+    no_pct: int = 10,
+    retx_ns: int = 40_000_000,
+    chaos: bool = True,
+) -> Workload:
+    """``no_pct``: percent chance a participant votes NO per transaction."""
+    n = 1 + n_parts
+    parts = list(range(1, n))
+    full_mask = (1 << n_parts) - 1
+
+    def _bcast_prepare(eb, txn, when, skip_mask):
+        # slots 0..P-1 (parity-critical ordering, like the other models)
+        for i, p in enumerate(parts):
+            eb.send(
+                p, user_kind(_H_PREPARE), (txn,),
+                when=when & (((skip_mask >> i) & 1) == 0),
+            )
+
+    def _bcast_decision(eb, txn, commit, when, skip_mask):
+        for i, p in enumerate(parts):
+            eb.send(
+                p, user_kind(_H_DECISION), (txn, commit),
+                when=when & (((skip_mask >> i) & 1) == 0),
+            )
+
+    def on_init(ctx):
+        is_coord = ctx.node == jnp.int32(COORD)
+        is_part = ~is_coord
+        eb = ctx.emits()
+        _bcast_prepare(eb, jnp.int32(1), is_coord, jnp.int32(0))
+        eb.after(retx_ns, user_kind(_H_RETX), COORD, (1,), when=is_coord)
+        # announce this (re)born participant; lossy, so retried by a
+        # timer until any traffic has been seen
+        eb.send(COORD, user_kind(_H_HELLO), (ctx.node,), when=is_part)
+        eb.after(retx_ns, user_kind(_H_HRETX), ctx.node, when=is_part)
+        if chaos:
+            who = ctx.draw.user_int(1, n, _P_KILL_WHO).astype(jnp.int32)
+            at = ctx.draw.user_int(20_000_000, 250_000_000, _P_KILL_AT)
+            revive = ctx.draw.user_int(80_000_000, 400_000_000, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=is_coord)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=is_coord)
+        new = jnp.where(is_coord, ctx.state.at[0].set(1), ctx.state)
+        return new, eb.build()
+
+    def on_prepare(ctx):
+        txn = ctx.args[0]
+        st = ctx.state
+        fresh = txn > st[0]
+        # the vote is drawn ONCE (at first receipt) and stored, so a
+        # retransmitted PREPARE re-sends the same vote — a participant
+        # cannot change its mind (2PC's vote durability, modulo the
+        # RAM-wipe crash the invariant documents)
+        roll = ctx.draw.user_int(0, 100, _P_VOTE)
+        new_vote = jnp.where(roll >= jnp.int64(no_pct), 1, 0).astype(jnp.int32)
+        vote = jnp.where(fresh, new_vote, st[1])
+        new = st.at[0].set(jnp.maximum(st[0], txn)).at[1].set(vote)
+        eb = ctx.emits()
+        eb.send(COORD, user_kind(_H_VOTE), (txn, ctx.node, vote))
+        return new, eb.build()
+
+    def on_vote(ctx):
+        txn, who, yes = ctx.args[0], ctx.args[1], ctx.args[2]
+        st = ctx.state
+        relevant = (txn == st[0]) & (st[1] == jnp.int32(0))
+        bit = jnp.int32(1) << (who - 1)
+        votes = jnp.where(relevant, st[2] | bit, st[2])
+        abort_now = relevant & (yes == jnp.int32(0))
+        commit_now = relevant & (yes != 0) & (votes == jnp.int32(full_mask))
+        decide = abort_now | commit_now
+        phase = jnp.where(
+            decide, jnp.where(abort_now, jnp.int32(2), jnp.int32(1)), st[1]
+        )
+        new = st.at[1].set(phase).at[2].set(votes).at[3].set(
+            jnp.where(decide, jnp.int32(0), st[3])
+        )
+        eb = ctx.emits()
+        _bcast_decision(
+            eb, txn, (phase == 1).astype(jnp.int32), decide, jnp.int32(0)
+        )
+        # no retx arm here: the per-transaction chain armed at prepare
+        # time keeps firing while this txn is current and re-sends
+        # whichever phase's messages are missing
+        return new, eb.build()
+
+    def on_decision(ctx):
+        txn, commit = ctx.args[0], ctx.args[1]
+        st = ctx.state
+        fresh = txn > st[2]
+        new = (
+            st.at[2].set(jnp.maximum(st[2], txn))
+            .at[3].set(st[3] + fresh.astype(jnp.int32))
+            # store the decision VALUE so agreement with the coordinator
+            # is checkable at halt (atomicity, not just delivery)
+            .at[4].set(jnp.where(fresh, commit, st[4]))
+        )
+        eb = ctx.emits()
+        eb.send(COORD, user_kind(_H_ACK), (txn, ctx.node))
+        return new, eb.build()
+
+    def on_ack(ctx):
+        txn, who = ctx.args[0], ctx.args[1]
+        st = ctx.state
+        relevant = (txn == st[0]) & (st[1] >= jnp.int32(1))
+        bit = jnp.int32(1) << (who - 1)
+        acks = jnp.where(relevant, st[3] | bit, st[3])
+        complete = relevant & (acks == jnp.int32(full_mask))
+        committed = st[1] == jnp.int32(1)
+        n_commit = st[4] + (complete & committed).astype(jnp.int32)
+        n_abort = st[5] + (complete & ~committed).astype(jnp.int32)
+        last = st[0] >= jnp.int32(txns)
+        advance = complete & ~last
+        nxt = jnp.where(advance, st[0] + 1, st[0])
+        new = (
+            st.at[0].set(nxt)
+            .at[1].set(jnp.where(advance, jnp.int32(0), st[1]))
+            .at[2].set(jnp.where(advance, jnp.int32(0), st[2]))
+            .at[3].set(acks)
+            .at[4].set(n_commit)
+            .at[5].set(n_abort)
+        )
+        eb = ctx.emits()
+        _bcast_prepare(eb, nxt, advance, jnp.int32(0))
+        eb.after(retx_ns, user_kind(_H_RETX), COORD, (nxt,), when=advance)
+        eb.halt(when=complete & last)
+        return new, eb.build()
+
+    def on_retx(ctx):
+        txn = ctx.args[0]
+        st = ctx.state
+        current = txn == st[0]
+        preparing = current & (st[1] == jnp.int32(0))
+        deciding = current & (st[1] >= jnp.int32(1))
+        eb = ctx.emits()
+        # missing votes -> re-PREPARE; missing acks -> re-DECISION. The
+        # two broadcasts share the per-participant slots 0..P-1 via the
+        # phase-dependent kind/args (one slot set per phase).
+        for i, p in enumerate(parts):
+            unheard_vote = preparing & (((st[2] >> i) & 1) == 0)
+            eb.send(p, user_kind(_H_PREPARE), (txn,), when=unheard_vote)
+        for i, p in enumerate(parts):
+            unacked = deciding & (((st[3] >> i) & 1) == 0)
+            eb.send(
+                p, user_kind(_H_DECISION),
+                (txn, (st[1] == 1).astype(jnp.int32)),
+                when=unacked,
+            )
+        eb.after(retx_ns, user_kind(_H_RETX), COORD, (txn,), when=current)
+        return ctx.state, eb.build()
+
+    def on_hello(ctx):
+        # a (re)born participant lost its RAM: clear its bit for the
+        # current transaction so the retransmit loop re-covers it — the
+        # recovery path for crash-after-ack (see module docstring)
+        who = ctx.args[0]
+        st = ctx.state
+        bit = jnp.int32(1) << (who - 1)
+        preparing = st[1] == jnp.int32(0)
+        votes = jnp.where(preparing, st[2] & ~bit, st[2])
+        acks = jnp.where(~preparing, st[3] & ~bit, st[3])
+        new = st.at[2].set(votes).at[3].set(acks)
+        return new, ctx.emits().build()
+
+    def on_hretx(ctx):
+        st = ctx.state
+        # retry until ANY traffic seen (a prepare or a decision)
+        unseen = (st[0] == jnp.int32(0)) & (st[2] == jnp.int32(0))
+        eb = ctx.emits()
+        eb.send(COORD, user_kind(_H_HELLO), (ctx.node,), when=unseen)
+        eb.after(retx_ns, user_kind(_H_HRETX), ctx.node, when=unseen)
+        return ctx.state, eb.build()
+
+    return Workload(
+        name="twophase",
+        n_nodes=n,
+        state_width=6,
+        handlers=(
+            on_init, on_prepare, on_vote, on_decision, on_ack, on_retx,
+            on_hello, on_hretx,
+        ),
+        # widest handlers: on_retx (2*P sends + 1 timer) and on_init
+        # (P prepares + retx + hello + hretx + 2 chaos)
+        max_emits=max(2 * n_parts + 1, n_parts + 5, 6),
+    )

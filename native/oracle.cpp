@@ -854,6 +854,164 @@ void kvchaos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   }
 }
 
+// twophase (models/twophase.py): coordinator-driven 2PC over n_parts
+// participants with stored votes, phase-aware retransmits, and a
+// scheduled participant kill/restart.
+struct TwoPhaseParams {
+  int32_t txns, n_parts, no_pct;
+  int64_t retx_ns;
+  int32_t chaos;
+};
+TwoPhaseParams g_tp{5, 4, 10, 40000000, 1};
+
+void twophase_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t COORD = 0;
+  const int32_t K_PREPARE = FIRST_USER_KIND + 1, K_VOTE = FIRST_USER_KIND + 2,
+                K_DECISION = FIRST_USER_KIND + 3, K_ACK = FIRST_USER_KIND + 4,
+                K_RETX = FIRST_USER_KIND + 5, K_HELLO = FIRST_USER_KIND + 6,
+                K_HRETX = FIRST_USER_KIND + 7;
+  const int32_t P_VOTE = 0, P_KILL_AT = 1, P_KILL_WHO = 2, P_REVIVE = 3;
+  const int32_t P = g_tp.n_parts;
+  const int32_t full_mask = (1 << P) - 1;
+  // slot ordering mirrors the Python EmitBuilder exactly (invalid rows
+  // still consume slot indices)
+  auto bcast_prepare = [&](int32_t txn, bool when, int32_t skip_mask) {
+    for (int32_t i = 0; i < P; i++)
+      eff->emits.push_back(mk_send(i + 1, K_PREPARE, txn, 0,
+                                   when && (((skip_mask >> i) & 1) == 0)));
+  };
+  auto bcast_decision = [&](int32_t txn, int32_t commit, bool when,
+                            int32_t skip_mask) {
+    for (int32_t i = 0; i < P; i++) {
+      Emit e = mk_send(i + 1, K_DECISION, txn, commit,
+                       when && (((skip_mask >> i) & 1) == 0));
+      eff->emits.push_back(e);
+    }
+  };
+  switch (h) {
+    case 0: {  // on_init
+      bool is_coord = ctx.node == COORD;
+      bool is_part = !is_coord;
+      bcast_prepare(1, is_coord, 0);
+      eff->emits.push_back(mk_after(g_tp.retx_ns, K_RETX, COORD, 1, is_coord));
+      eff->emits.push_back(mk_send(COORD, K_HELLO, ctx.node, 0, is_part));
+      eff->emits.push_back(
+          mk_after(g_tp.retx_ns, K_HRETX, ctx.node, 0, is_part));
+      if (g_tp.chaos) {
+        int64_t who = ctx.draw.user_int(1, 1 + P, P_KILL_WHO);
+        int64_t at = ctx.draw.user_int(20000000, 250000000, P_KILL_AT);
+        int64_t revive = ctx.draw.user_int(80000000, 400000000, P_REVIVE);
+        eff->emits.push_back(
+            mk_after(at, KIND_KILL, 0, static_cast<int32_t>(who), is_coord));
+        eff->emits.push_back(mk_after(at + revive, KIND_RESTART, 0,
+                                      static_cast<int32_t>(who), is_coord));
+      }
+      if (is_coord) ns[0] = 1;
+      break;
+    }
+    case 1: {  // on_prepare at participant
+      int32_t txn = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool fresh = txn > st[0];
+      int64_t roll = ctx.draw.user_int(0, 100, P_VOTE);
+      int32_t new_vote = roll >= g_tp.no_pct ? 1 : 0;
+      int32_t vote = fresh ? new_vote : st[1];
+      ns[0] = st[0] > txn ? st[0] : txn;
+      ns[1] = vote;
+      Emit e = mk_send(COORD, K_VOTE, txn, ctx.node, true);
+      e.args[2] = vote;
+      eff->emits.push_back(e);
+      break;
+    }
+    case 2: {  // on_vote at coordinator
+      int32_t txn = ctx.args[0], who = ctx.args[1], yes = ctx.args[2];
+      const int32_t* st = ctx.state;
+      bool relevant = txn == st[0] && st[1] == 0;
+      int32_t bit = int32_t{1} << (who - 1);
+      int32_t votes = relevant ? (st[2] | bit) : st[2];
+      bool abort_now = relevant && yes == 0;
+      bool commit_now = relevant && yes != 0 && votes == full_mask;
+      bool decide = abort_now || commit_now;
+      int32_t phase = decide ? (abort_now ? 2 : 1) : st[1];
+      ns[1] = phase;
+      ns[2] = votes;
+      ns[3] = decide ? 0 : st[3];
+      // no retx arm: the per-transaction chain from prepare time covers
+      // both phases (engine on_vote mirrors)
+      bcast_decision(txn, phase == 1 ? 1 : 0, decide, 0);
+      break;
+    }
+    case 3: {  // on_decision at participant
+      int32_t txn = ctx.args[0], commit = ctx.args[1];
+      const int32_t* st = ctx.state;
+      bool fresh = txn > st[2];
+      ns[2] = st[2] > txn ? st[2] : txn;
+      ns[3] = st[3] + (fresh ? 1 : 0);
+      ns[4] = fresh ? commit : st[4];  // stored decision VALUE (agreement)
+      eff->emits.push_back(mk_send(COORD, K_ACK, txn, ctx.node, true));
+      break;
+    }
+    case 4: {  // on_ack at coordinator
+      int32_t txn = ctx.args[0], who = ctx.args[1];
+      const int32_t* st = ctx.state;
+      bool relevant = txn == st[0] && st[1] >= 1;
+      int32_t bit = int32_t{1} << (who - 1);
+      int32_t acks = relevant ? (st[3] | bit) : st[3];
+      bool complete = relevant && acks == full_mask;
+      bool committed = st[1] == 1;
+      bool last = st[0] >= g_tp.txns;
+      bool advance = complete && !last;
+      int32_t nxt = advance ? st[0] + 1 : st[0];
+      ns[0] = nxt;
+      ns[1] = advance ? 0 : st[1];
+      ns[2] = advance ? 0 : st[2];
+      ns[3] = acks;
+      ns[4] = st[4] + ((complete && committed) ? 1 : 0);
+      ns[5] = st[5] + ((complete && !committed) ? 1 : 0);
+      bcast_prepare(nxt, advance, 0);
+      eff->emits.push_back(
+          mk_after(g_tp.retx_ns, K_RETX, COORD, nxt, advance));
+      eff->emits.push_back(mk_after(0, KIND_HALT, 0, 0, complete && last));
+      break;
+    }
+    case 5: {  // on_retx at coordinator
+      int32_t txn = ctx.args[0];
+      const int32_t* st = ctx.state;
+      bool current = txn == st[0];
+      bool preparing = current && st[1] == 0;
+      bool deciding = current && st[1] >= 1;
+      for (int32_t i = 0; i < P; i++)
+        eff->emits.push_back(
+            mk_send(i + 1, K_PREPARE, txn, 0,
+                    preparing && (((st[2] >> i) & 1) == 0)));
+      for (int32_t i = 0; i < P; i++)
+        eff->emits.push_back(
+            mk_send(i + 1, K_DECISION, txn, st[1] == 1 ? 1 : 0,
+                    deciding && (((st[3] >> i) & 1) == 0)));
+      eff->emits.push_back(
+          mk_after(g_tp.retx_ns, K_RETX, COORD, txn, current));
+      break;
+    }
+    case 6: {  // on_hello at coordinator
+      int32_t who = ctx.args[0];
+      const int32_t* st = ctx.state;
+      int32_t bit = int32_t{1} << (who - 1);
+      bool preparing = st[1] == 0;
+      ns[2] = preparing ? (st[2] & ~bit) : st[2];
+      ns[3] = !preparing ? (st[3] & ~bit) : st[3];
+      break;
+    }
+    case 7: {  // on_hretx at participant
+      const int32_t* st = ctx.state;
+      bool unseen = st[0] == 0 && st[2] == 0;
+      eff->emits.push_back(mk_send(COORD, K_HELLO, ctx.node, 0, unseen));
+      eff->emits.push_back(
+          mk_after(g_tp.retx_ns, K_HRETX, ctx.node, 0, unseen));
+      break;
+    }
+  }
+}
+
 Workload make_workload(int32_t id) {
   switch (id) {
     case 0:  // pingpong
@@ -872,6 +1030,12 @@ Workload make_workload(int32_t id) {
       if (k < 6) k = 6;
       return Workload{g_kv.n_replicas + 2, g_kv.payload ? 6 : 4, 10, k,
                       kvchaos_handler, g_kv.payload ? 2 : 0};
+    }
+    case 5: {  // twophase: max_emits = max(2P+1, P+5, 6)
+      int32_t k = 2 * g_tp.n_parts + 1;
+      if (k < g_tp.n_parts + 5) k = g_tp.n_parts + 5;
+      if (k < 6) k = 6;
+      return Workload{1 + g_tp.n_parts, 6, 8, k, twophase_handler};
     }
     default:
       return Workload{0, 0, 0, 0, nullptr};
@@ -895,6 +1059,10 @@ void oracle_set_raft(int32_t n_nodes, int64_t tmin, int64_t tmax) {
 void oracle_set_broadcast(int32_t rounds, int32_t n_nodes, int64_t retx_ns,
                           int32_t partition) {
   g_bc = {rounds, n_nodes, retx_ns, partition};
+}
+void oracle_set_twophase(int32_t txns, int32_t n_parts, int32_t no_pct,
+                         int64_t retx_ns, int32_t chaos) {
+  g_tp = {txns, n_parts, no_pct, retx_ns, chaos};
 }
 void oracle_set_kvchaos(int32_t writes, int32_t n_replicas, int64_t retx_ns,
                         int64_t client_retx_ns, int32_t chaos,

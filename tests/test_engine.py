@@ -516,3 +516,26 @@ def test_check_layouts_all_models(name):
 
     factory, cfg_kwargs, _seeds, _steps = BENCH_SPECS[name]
     check_layouts(factory(), EngineConfig(**cfg_kwargs), np.arange(8), 150)
+
+
+def test_twophase_atomicity_under_chaos():
+    # 2PC invariants across seeded chaos schedules: every transaction
+    # decided, the final decision applied by every participant, and the
+    # commit tally bounded by txns
+    from madsim_tpu.models import make_twophase
+
+    wl = make_twophase(txns=5)
+    cfg = EngineConfig(pool_size=48, loss_p=0.03)
+    out = run_workload(wl, cfg, np.arange(256), 1400)
+    ns = np.asarray(out.node_state)
+    assert bool(np.asarray(out.halted).all()), "all schedules complete"
+    assert int(np.asarray(out.overflow).sum()) == 0
+    coord = ns[:, 0]
+    assert ((coord[:, 4] + coord[:, 5]) == 5).all(), "every txn decided"
+    assert (ns[:, 1:5, 2] == 5).all(), "final decision reached everyone"
+    # atomicity: every participant's stored decision VALUE for the final
+    # transaction matches the coordinator's (phase 1 = commit)
+    coord_committed = (coord[:, 1] == 1).astype(np.int32)
+    assert (ns[:, 1:5, 4] == coord_committed[:, None]).all(), (
+        "a participant disagrees with the coordinator's final decision"
+    )

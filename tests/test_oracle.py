@@ -24,6 +24,7 @@ from madsim_tpu.models import (
     make_microbench,
     make_pingpong,
     make_raft,
+    make_twophase,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -140,3 +141,18 @@ def test_big_seed_values():
     cfg = EngineConfig(pool_size=64)
     seeds = [2**63 - 1, 2**40 + 17, 123456789012345]
     compare(wl, cfg, seeds, 150, rounds=3)
+
+
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_twophase_traces_bit_identical(layout):
+    # 2PC: stored votes, phase-aware retransmits, participant
+    # kill/restart — the sixth oracle-verified protocol family
+    wl = make_twophase(txns=4)
+    cfg = EngineConfig(pool_size=64, loss_p=0.03)
+    compare(wl, cfg, list(range(12)), 500, layout=layout, txns=4)
+
+
+def test_twophase_no_chaos_bit_identical():
+    wl = make_twophase(txns=3, chaos=False)
+    cfg = EngineConfig(pool_size=64, loss_p=0.05)
+    compare(wl, cfg, list(range(8)), 400, txns=3, chaos=False)

@@ -112,6 +112,8 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int32(model_kwargs.get("no_pct", 10)),
             ctypes.c_int64(model_kwargs.get("retx_ns", 40_000_000)),
             ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
+            ctypes.c_int64(model_kwargs.get("revive_min_ns", 80_000_000)),
+            ctypes.c_int64(model_kwargs.get("revive_max_ns", 400_000_000)),
         )
     elif wl.name in ("kvchaos", "kvchaos-payload"):
         lib.oracle_set_kvchaos(

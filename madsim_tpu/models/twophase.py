@@ -14,7 +14,22 @@ the coordinator clears the reborn node's vote/ack bit for the current
 transaction so the retransmit loop re-covers it — without this, a
 participant that acked the final decision and then crashed+restarted
 before completion would never be re-sent the decision (its ack bit is
-already set) and would halt ignorant of it.
+already set) and would halt ignorant of it. HELLO alone still races
+(it is lossy and takes a latency to arrive; completion can land inside
+that window), so the coordinator — which schedules the kill/restart
+chaos itself — also arms a loss-free local RESYNC timer at the revive
+time that clears the same bit deterministically.
+
+The RESYNC guarantee is config-conditional, not structural: it requires
+every pre-crash message to have drained before the resync fires, i.e.
+``revive_min_ns > cfg.lat_max_ns`` (a stale in-flight ack arriving
+after the RESYNC would re-set the cleared bit). The default
+``revive_min_ns`` of 80 ms comfortably exceeds the engine's default
+10 ms latency cap; raise it in step with any larger ``lat_max_ns``.
+Beyond that, an unrelated ack carrying the exact revive timestamp can
+dispatch before the RESYNC under the engine's deterministic same-time
+ordering; the 4,096-schedule chaos-search soak is the standing evidence
+neither residue occurs for the shipped parameters.
 
 Halt condition: every transaction decided AND the final decision acked
 by every participant. Invariants the tests / chaos search check at
@@ -45,6 +60,7 @@ _H_ACK = 4  # at coordinator: args = (txn, part)
 _H_RETX = 5  # at coordinator: args = (txn,)
 _H_HELLO = 6  # at coordinator: args = (part,) — a (re)born participant
 _H_HRETX = 7  # at participant: retry HELLO until any traffic seen
+_H_RESYNC = 8  # at coordinator: args = (part,) — scheduled at revive time
 
 # user draw purposes
 _P_VOTE = 0
@@ -59,8 +75,12 @@ def make_twophase(
     no_pct: int = 10,
     retx_ns: int = 40_000_000,
     chaos: bool = True,
+    revive_min_ns: int = 80_000_000,
+    revive_max_ns: int = 400_000_000,
 ) -> Workload:
-    """``no_pct``: percent chance a participant votes NO per transaction."""
+    """``no_pct``: percent chance a participant votes NO per transaction.
+    ``revive_min_ns`` must exceed the engine config's ``lat_max_ns`` for
+    the crash-recovery guarantee (module docstring)."""
     n = 1 + n_parts
     parts = list(range(1, n))
     full_mask = (1 << n_parts) - 1
@@ -93,9 +113,15 @@ def make_twophase(
         if chaos:
             who = ctx.draw.user_int(1, n, _P_KILL_WHO).astype(jnp.int32)
             at = ctx.draw.user_int(20_000_000, 250_000_000, _P_KILL_AT)
-            revive = ctx.draw.user_int(80_000_000, 400_000_000, _P_REVIVE)
+            revive = ctx.draw.user_int(revive_min_ns, revive_max_ns, _P_REVIVE)
             eb.after(at, KIND_KILL, 0, (who,), when=is_coord)
             eb.after(at + revive, KIND_RESTART, 0, (who,), when=is_coord)
+            # loss-free local resync at the revive time: the reliable
+            # half of the crash-after-ack recovery (see docstring)
+            eb.after(
+                at + revive, user_kind(_H_RESYNC), COORD, (who,),
+                when=is_coord,
+            )
         new = jnp.where(is_coord, ctx.state.at[0].set(1), ctx.state)
         return new, eb.build()
 
@@ -204,18 +230,25 @@ def make_twophase(
         eb.after(retx_ns, user_kind(_H_RETX), COORD, (txn,), when=current)
         return ctx.state, eb.build()
 
-    def on_hello(ctx):
+    def _clear_bit(ctx):
         # a (re)born participant lost its RAM: clear its bit for the
         # current transaction so the retransmit loop re-covers it — the
-        # recovery path for crash-after-ack (see module docstring)
+        # recovery path for crash-after-ack (see module docstring).
+        # Shared by on_hello (lossy, covers externally injected kills)
+        # and on_resync (loss-free, covers the scheduled chaos).
         who = ctx.args[0]
         st = ctx.state
         bit = jnp.int32(1) << (who - 1)
         preparing = st[1] == jnp.int32(0)
         votes = jnp.where(preparing, st[2] & ~bit, st[2])
         acks = jnp.where(~preparing, st[3] & ~bit, st[3])
-        new = st.at[2].set(votes).at[3].set(acks)
-        return new, ctx.emits().build()
+        return st.at[2].set(votes).at[3].set(acks)
+
+    def on_hello(ctx):
+        return _clear_bit(ctx), ctx.emits().build()
+
+    def on_resync(ctx):
+        return _clear_bit(ctx), ctx.emits().build()
 
     def on_hretx(ctx):
         st = ctx.state
@@ -232,9 +265,9 @@ def make_twophase(
         state_width=6,
         handlers=(
             on_init, on_prepare, on_vote, on_decision, on_ack, on_retx,
-            on_hello, on_hretx,
+            on_hello, on_hretx, on_resync,
         ),
         # widest handlers: on_retx (2*P sends + 1 timer) and on_init
-        # (P prepares + retx + hello + hretx + 2 chaos)
-        max_emits=max(2 * n_parts + 1, n_parts + 5, 6),
+        # (P prepares + retx + hello + hretx + 3 chaos)
+        max_emits=max(2 * n_parts + 1, n_parts + 6, 6),
     )

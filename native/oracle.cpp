@@ -861,15 +861,16 @@ struct TwoPhaseParams {
   int32_t txns, n_parts, no_pct;
   int64_t retx_ns;
   int32_t chaos;
+  int64_t revive_min_ns, revive_max_ns;
 };
-TwoPhaseParams g_tp{5, 4, 10, 40000000, 1};
+TwoPhaseParams g_tp{5, 4, 10, 40000000, 1, 80000000, 400000000};
 
 void twophase_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   const int32_t COORD = 0;
   const int32_t K_PREPARE = FIRST_USER_KIND + 1, K_VOTE = FIRST_USER_KIND + 2,
                 K_DECISION = FIRST_USER_KIND + 3, K_ACK = FIRST_USER_KIND + 4,
                 K_RETX = FIRST_USER_KIND + 5, K_HELLO = FIRST_USER_KIND + 6,
-                K_HRETX = FIRST_USER_KIND + 7;
+                K_HRETX = FIRST_USER_KIND + 7, K_RESYNC = FIRST_USER_KIND + 8;
   const int32_t P_VOTE = 0, P_KILL_AT = 1, P_KILL_WHO = 2, P_REVIVE = 3;
   const int32_t P = g_tp.n_parts;
   const int32_t full_mask = (1 << P) - 1;
@@ -900,10 +901,14 @@ void twophase_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
       if (g_tp.chaos) {
         int64_t who = ctx.draw.user_int(1, 1 + P, P_KILL_WHO);
         int64_t at = ctx.draw.user_int(20000000, 250000000, P_KILL_AT);
-        int64_t revive = ctx.draw.user_int(80000000, 400000000, P_REVIVE);
+        int64_t revive =
+            ctx.draw.user_int(g_tp.revive_min_ns, g_tp.revive_max_ns, P_REVIVE);
         eff->emits.push_back(
             mk_after(at, KIND_KILL, 0, static_cast<int32_t>(who), is_coord));
         eff->emits.push_back(mk_after(at + revive, KIND_RESTART, 0,
+                                      static_cast<int32_t>(who), is_coord));
+        // loss-free local resync at the revive time (engine on_init)
+        eff->emits.push_back(mk_after(at + revive, K_RESYNC, COORD,
                                       static_cast<int32_t>(who), is_coord));
       }
       if (is_coord) ns[0] = 1;
@@ -992,7 +997,8 @@ void twophase_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
           mk_after(g_tp.retx_ns, K_RETX, COORD, txn, current));
       break;
     }
-    case 6: {  // on_hello at coordinator
+    case 6:    // on_hello at coordinator
+    case 8: {  // on_resync at coordinator (same bit-clear, loss-free)
       int32_t who = ctx.args[0];
       const int32_t* st = ctx.state;
       int32_t bit = int32_t{1} << (who - 1);
@@ -1031,11 +1037,11 @@ Workload make_workload(int32_t id) {
       return Workload{g_kv.n_replicas + 2, g_kv.payload ? 6 : 4, 10, k,
                       kvchaos_handler, g_kv.payload ? 2 : 0};
     }
-    case 5: {  // twophase: max_emits = max(2P+1, P+5, 6)
+    case 5: {  // twophase: max_emits = max(2P+1, P+6, 6)
       int32_t k = 2 * g_tp.n_parts + 1;
-      if (k < g_tp.n_parts + 5) k = g_tp.n_parts + 5;
+      if (k < g_tp.n_parts + 6) k = g_tp.n_parts + 6;
       if (k < 6) k = 6;
-      return Workload{1 + g_tp.n_parts, 6, 8, k, twophase_handler};
+      return Workload{1 + g_tp.n_parts, 6, 9, k, twophase_handler};
     }
     default:
       return Workload{0, 0, 0, 0, nullptr};
@@ -1061,8 +1067,9 @@ void oracle_set_broadcast(int32_t rounds, int32_t n_nodes, int64_t retx_ns,
   g_bc = {rounds, n_nodes, retx_ns, partition};
 }
 void oracle_set_twophase(int32_t txns, int32_t n_parts, int32_t no_pct,
-                         int64_t retx_ns, int32_t chaos) {
-  g_tp = {txns, n_parts, no_pct, retx_ns, chaos};
+                         int64_t retx_ns, int32_t chaos,
+                         int64_t revive_min_ns, int64_t revive_max_ns) {
+  g_tp = {txns, n_parts, no_pct, retx_ns, chaos, revive_min_ns, revive_max_ns};
 }
 void oracle_set_kvchaos(int32_t writes, int32_t n_replicas, int64_t retx_ns,
                         int64_t client_retx_ns, int32_t chaos,

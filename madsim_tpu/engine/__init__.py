@@ -41,6 +41,7 @@ from .core import (  # noqa: E402,F401
     make_step,
     user_kind,
 )
+from .compact import make_run_compacted  # noqa: E402,F401
 from .verify import check_determinism, check_layouts, compare_traces  # noqa: E402,F401
 from .checkpoint import load as load_checkpoint  # noqa: E402,F401
 from .checkpoint import save as save_checkpoint  # noqa: E402,F401

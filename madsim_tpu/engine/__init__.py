@@ -39,6 +39,7 @@ from .core import (  # noqa: E402,F401
     make_run,
     make_run_while,
     make_step,
+    time32_eligible,
     user_kind,
 )
 from .compact import make_run_compacted  # noqa: E402,F401

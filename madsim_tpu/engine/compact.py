@@ -84,6 +84,7 @@ def make_run_compacted(
     cfg: EngineConfig,
     max_steps: int,
     layout: str | None = None,
+    time32: bool | None = None,
     shrink: int = 4,
     min_size: int = 2048,
     fields: tuple = RESULT_FIELDS,
@@ -100,7 +101,7 @@ def make_run_compacted(
     ``min_size >= n_seeds`` the program degenerates to exactly one
     while_loop — the plain ``make_run_while``.
     """
-    step = jax.vmap(make_step(wl, cfg, layout))
+    step = jax.vmap(make_step(wl, cfg, layout, time32))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:
         if f not in all_names:

@@ -91,9 +91,12 @@ __all__ = [
     "make_init",
     "make_step",
     "make_run",
+    "time32_eligible",
 ]
 
 _INF_NS = np.int64(2**62)
+_INF_32 = np.int32(2**31 - 1)
+_T32_LIMIT = 2**31 - 1  # max future-event offset representable in int32
 _TRACE_PRIME = np.uint64(0x100000001B3)
 _TRACE_MIX = np.uint64(0x9E3779B97F4A7C15)
 
@@ -335,6 +338,13 @@ class Workload:
     # payload lifetime equals event lifetime, so the arena IS the event
     # pool — no separate allocator, no leaks
     payload_words: int = 0
+    # largest timer delay (ns) any handler can pass to EmitBuilder.after.
+    # Declaring it (together with config bounds, see time32_eligible)
+    # unlocks the int32 event-time representation on accelerators; None
+    # = unknown, keep int64 times. The engine still guards the claim at
+    # runtime: a timer emit beyond the int32 horizon is counted into
+    # `overflow`, which the bench refuses (bench.py pool_overflow path)
+    delay_bound_ns: int | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -370,7 +380,8 @@ class SimState:
     overflow: jnp.ndarray  # () int32 events dropped to pool overflow
     msg_count: jnp.ndarray  # () int64 — Stat{msg_count} (network.rs:106-111)
     # event pool, E slots
-    ev_time: jnp.ndarray  # (E,) int64
+    ev_time: jnp.ndarray  # (E,) int64 absolute ns — or, under time32
+    #                          (make_step), int32 offset from `now`
     ev_valid: jnp.ndarray  # (E,) bool
     ev_kind: jnp.ndarray  # (E,) int32
     ev_node: jnp.ndarray  # (E,) int32 target node
@@ -398,17 +409,58 @@ class SimState:
 # ---------------------------------------------------------------------------
 
 
-def make_init(wl: Workload, cfg: EngineConfig):
+def time32_eligible(wl: Workload, cfg: EngineConfig) -> bool:
+    """Whether this (workload, config) pair can use int32 event times.
+
+    Pool times under ``time32`` are offsets from the current clock; they
+    only shrink as the clock advances, so the static bound is just the
+    largest offset any insertion can create: a handler timer
+    (``delay_bound_ns``), a network latency draw, or a clog-backoff
+    reschedule (cap + the <1 µs jitter draw). The headroom subtracts
+    ``proc_max_ns + 1`` so (a) a maximal valid offset stays strictly
+    below the ``_INF_32`` invalid-slot sentinel in the pop, and (b) the
+    per-step clock advance (offset + poll cost) can never overflow the
+    int32 rebase.
+    """
+    lim = _T32_LIMIT - cfg.proc_max_ns - 1
+    return (
+        wl.delay_bound_ns is not None
+        and wl.delay_bound_ns <= lim
+        and cfg.lat_max_ns <= lim
+        and cfg.clog_backoff_max_ns + 1_000 <= lim
+    )
+
+
+def _resolve_time32(wl: Workload, cfg: EngineConfig, time32: bool | None) -> bool:
+    if time32 is None:
+        # int64 is native on CPU; accelerators (v5e has no 64-bit lanes)
+        # get the narrow representation whenever the bounds allow it
+        return time32_eligible(wl, cfg) and jax.default_backend() != "cpu"
+    if time32 and not time32_eligible(wl, cfg):
+        raise ValueError(
+            f"time32 requested but {wl.name} / config are not eligible: "
+            f"need delay_bound_ns ({wl.delay_bound_ns}), lat_max_ns "
+            f"({cfg.lat_max_ns}) and clog_backoff_max_ns+1000 "
+            f"({cfg.clog_backoff_max_ns + 1000}) all <= "
+            f"{_T32_LIMIT - cfg.proc_max_ns - 1}"
+        )
+    return bool(time32)
+
+
+def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
     Seeds every node with an on_init event at t=0, mirroring the builder
-    running each node's init task at simulation start.
+    running each node's init task at simulation start. ``time32`` must
+    match the value resolved by :func:`make_step` (both default to the
+    same automatic rule, so callers normally pass neither).
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     if e < n:
         raise ValueError(f"pool_size={e} must hold at least one event per node ({n})")
     del k
     w = wl.payload_words
+    tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
     base_state = jnp.asarray(wl.initial_state())
 
     def init_one(seed) -> SimState:
@@ -426,7 +478,7 @@ def make_init(wl: Workload, cfg: EngineConfig):
             trace=jnp.uint64(0),
             overflow=jnp.int32(0),
             msg_count=jnp.int64(0),
-            ev_time=jnp.zeros((e,), jnp.int64),
+            ev_time=jnp.zeros((e,), tdtype),
             ev_valid=ev_valid,
             ev_kind=ev_kind,
             ev_node=ev_node,
@@ -472,7 +524,12 @@ def _trace_fold(trace, now, kind, node, args, pay=None):
     return trace * _TRACE_PRIME + h
 
 
-def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
+def make_step(
+    wl: Workload,
+    cfg: EngineConfig,
+    layout: str | None = None,
+    time32: bool | None = None,
+):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
     Pops the earliest pending event, dispatches it through
@@ -490,6 +547,17 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
     * ``"scatter"`` — dynamic indexing and ``.at[].set`` scatters, the
       natural (and faster) lowering on CPU.
     * ``None`` (default) — scatter on the CPU backend, dense elsewhere.
+
+    ``time32`` picks the *representation* of pool event times — again
+    value-identical (tests/test_engine.py asserts it):
+
+    * ``True`` — ``ev_time`` holds int32 offsets from ``now``, rebased
+      by the clock advance each step. Every per-slot time op (the
+      argmin, the placement selects) becomes native-width on TPU (v5e
+      emulates 64-bit lanes at ~2x cost). Requires
+      :func:`time32_eligible` bounds.
+    * ``False`` — absolute int64 nanoseconds, the natural CPU form.
+    * ``None`` (default) — int32 on accelerators when eligible.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -501,6 +569,8 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
     if layout not in ("dense", "scatter"):
         raise ValueError(f"unknown layout {layout!r}")
     dense = layout == "dense"
+    time32 = _resolve_time32(wl, cfg, time32)
+    t_inf = _INF_32 if time32 else _INF_NS
 
     # -- user branch table -------------------------------------------------
     # Only USER handlers go through lax.switch; engine kinds (kill, clog,
@@ -538,6 +608,19 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
     time_limit = np.int64(cfg.time_limit_ns) if cfg.time_limit_ns else _INF_NS
 
     def step(st: SimState) -> SimState:
+        # representation guard (trace-time): a state built or restored
+        # under the other time representation would be silently
+        # misread — e.g. a checkpoint saved where time32 auto-resolved
+        # differently (engine/checkpoint.py). Dtypes are static, so
+        # this costs nothing in the compiled program.
+        expected_t = jnp.int32 if time32 else jnp.int64
+        if st.ev_time.dtype != expected_t:
+            raise TypeError(
+                f"SimState.ev_time has dtype {st.ev_time.dtype} but this "
+                f"step was built with time32={time32} (expects "
+                f"{jnp.dtype(expected_t).name}); build init/step with "
+                f"matching explicit time32= values"
+            )
         # ---- pop the earliest pending event (the timer-jump of
         # time/mod.rs:45-60 merged with the ready-queue drain) ----
         # Two value-identical lowerings of every per-event read/write
@@ -547,7 +630,7 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
         # TPU, examples/profile_step.py); scatter = plain indexing with
         # in_range masks so OOB handling matches dense and the oracle.
         e_slots = st.ev_valid.shape[0]
-        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        tmask = jnp.where(st.ev_valid, st.ev_time, t_inf)
         i = jnp.argmin(tmask)
         slot_ids = jnp.arange(e_slots, dtype=jnp.int32)
         is_popped = slot_ids == i.astype(jnp.int32)
@@ -567,7 +650,13 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
 
         has_event = jnp.any(st.ev_valid & is_popped)
         ev_time_i = pick_slot(st.ev_time)
-        ev_t = jnp.maximum(st.now, ev_time_i)
+        if time32:
+            # offsets are relative to st.now; a (slightly) negative
+            # offset is an event whose time the clock already passed by
+            # a poll cost — identical to the absolute-form maximum
+            ev_t = st.now + jnp.maximum(ev_time_i, 0).astype(jnp.int64)
+        else:
+            ev_t = jnp.maximum(st.now, ev_time_i)
         over_limit = ev_t > time_limit
         active = has_event & ~st.halted & ~over_limit
 
@@ -638,14 +727,28 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
         )
         backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
         resched = active & blocked & (is_engine | live)
+        if time32:
+            # rebase every offset by this step's clock advance so the
+            # pool stays relative to the post-step clock. A reschedule
+            # only happens when dispatch is false, so now_after == now
+            # and the backoff offset needs no correction. Stale offsets
+            # in invalid slots may wrap; they are masked at every use.
+            adv32 = (now_after - st.now).astype(jnp.int32)
+            ev_time_reb = st.ev_time - adv32
+            back_t = backoff.astype(jnp.int32)
+            old_t = ev_time_i - adv32
+        else:
+            ev_time_reb = st.ev_time
+            back_t = now + backoff
+            old_t = ev_time_i
         if dense:
             ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
-            ev_time_mid = jnp.where(is_popped & resched, now + backoff, st.ev_time)
+            ev_time_mid = jnp.where(is_popped & resched, back_t, ev_time_reb)
             ev_retry_mid = jnp.where(is_popped & resched, retries + 1, st.ev_retry)
         else:
             ev_valid_mid = st.ev_valid.at[i].set(resched)
-            ev_time_mid = st.ev_time.at[i].set(
-                jnp.where(resched, now + backoff, ev_time_i)
+            ev_time_mid = ev_time_reb.at[i].set(
+                jnp.where(resched, back_t, old_t)
             )
             ev_retry_mid = st.ev_retry.at[i].set(
                 jnp.where(resched, retries + 1, retries)
@@ -745,7 +848,10 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
             lambda s: draw.bits2(jnp.uint32(PURPOSE_LATENCY) + s)
         )(slot_ix)
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
-        latency = jnp.int64(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int64)
+        if time32:  # same value, native width (lat_max fits by eligibility)
+            latency = jnp.int32(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int32)
+        else:
+            latency = jnp.int64(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int64)
         # loss_u32 == 2^32 is the static always-drop path (loss_p=1.0);
         # a uint32 compare can't express it (chance_threshold contract)
         if loss_u32 >= (1 << 32):
@@ -754,6 +860,21 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
             lost = em.send & (loss_bits < jnp.uint32(loss_u32))
 
         e_valid = dispatch & em.valid & ~lost
+        if time32:
+            # runtime backstop for the declared delay_bound_ns: a timer
+            # past the int32 horizon would corrupt the offset form, so
+            # it is clamped (to the max offset eligibility allows — the
+            # sentinel/rebase headroom) and counted as an overflow
+            # (loud — bench refuses any run with a nonzero overflow)
+            lim32 = _T32_LIMIT - cfg.proc_max_ns - 1
+            delay_over = e_valid & ~em.send & (em.delay > jnp.int64(lim32))
+            n_delay_over = jnp.sum(delay_over).astype(jnp.int32)
+            delay_t = jnp.minimum(em.delay, jnp.int64(lim32)).astype(
+                jnp.int32
+            )
+        else:
+            n_delay_over = jnp.int32(0)
+            delay_t = em.delay
         # sends to dead nodes are dropped at send time (socket gone,
         # network.rs:311-313); timers to dead nodes die via the epoch gate
         if dense:
@@ -768,7 +889,12 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
             alive_at_dst = alive[em_dst_c] & em_in_range
             e_epoch = jnp.where(em_in_range, epoch[em_dst_c], 0)
         e_valid = e_valid & jnp.where(em.send, alive_at_dst, True)
-        e_time = now_after + jnp.where(em.send, latency, em.delay)
+        if time32:
+            # offsets are relative to the post-step clock, which is
+            # exactly now_after — no addition needed at all
+            e_time = jnp.where(em.send, latency, delay_t)
+        else:
+            e_time = now_after + jnp.where(em.send, latency, delay_t)
         e_src = jnp.where(em.send, dst, jnp.int32(-1))
         # engine-kind events bypass the epoch gate; keep their slot epoch 0
         e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
@@ -789,7 +915,7 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
             free_rank = jnp.cumsum(~ev_valid_mid) - 1
             n_free = jnp.sum((~ev_valid_mid).astype(jnp.int32))
             dropped = e_valid & (pos >= n_free)
-            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
+            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32) + n_delay_over
 
             match = (
                 (~ev_valid_mid)[:, None]
@@ -823,7 +949,7 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
                 e_valid, free[jnp.clip(pos, 0, k1 - 1)], jnp.int32(e_slots)
             )
             dropped = e_valid & (slot >= e_slots)
-            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32)
+            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32) + n_delay_over
             ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
             ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
             ev_kind = st.ev_kind.at[slot].set(em.kind, mode="drop")
@@ -870,7 +996,13 @@ def make_step(wl: Workload, cfg: EngineConfig, layout: str | None = None):
     return step
 
 
-def make_run(wl: Workload, cfg: EngineConfig, n_steps: int, layout: str | None = None):
+def make_run(
+    wl: Workload,
+    cfg: EngineConfig,
+    n_steps: int,
+    layout: str | None = None,
+    time32: bool | None = None,
+):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
     The returned function is jit-friendly and sharding-friendly: every
@@ -878,7 +1010,7 @@ def make_run(wl: Workload, cfg: EngineConfig, n_steps: int, layout: str | None =
     axis turns this into pure data-parallel work across chips with zero
     collectives in the hot loop (results are combined host-side).
     """
-    step = jax.vmap(make_step(wl, cfg, layout))
+    step = jax.vmap(make_step(wl, cfg, layout, time32))
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -891,7 +1023,11 @@ def make_run(wl: Workload, cfg: EngineConfig, n_steps: int, layout: str | None =
 
 
 def make_run_while(
-    wl: Workload, cfg: EngineConfig, max_steps: int, layout: str | None = None
+    wl: Workload,
+    cfg: EngineConfig,
+    max_steps: int,
+    layout: str | None = None,
+    time32: bool | None = None,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -902,7 +1038,7 @@ def make_run_while(
     all-halted reduction runs per iteration; with a sharded seed axis it
     is XLA's only collective in the loop (a cheap scalar all-reduce).
     """
-    step = jax.vmap(make_step(wl, cfg, layout))
+    step = jax.vmap(make_step(wl, cfg, layout, time32))
 
     def run(state: SimState) -> SimState:
         def cond(carry):

@@ -15,7 +15,7 @@ import numpy as np
 import jax
 
 from ..runtime.rand import DeterminismError
-from .core import EngineConfig, Workload, make_init, make_run
+from .core import EngineConfig, Workload, make_init, make_run, time32_eligible
 
 __all__ = ["check_determinism", "check_layouts", "compare_traces"]
 
@@ -67,23 +67,39 @@ def check_layouts(
     gather/scatter semantics diverge from the dense masks.
     """
     seeds = np.asarray(seeds, np.uint64)
-    init = make_init(wl, cfg)
-    dense = jax.jit(make_run(wl, cfg, n_steps, layout="dense"))(init(seeds))
-    scatter = jax.jit(make_run(wl, cfg, n_steps, layout="scatter"))(init(seeds))
-    compare_traces(dense, scatter, what=f"{wl.name} dense-vs-scatter")
-    # the trace doesn't see everything (dropped-on-overflow events, a
-    # mis-masked state write after the last fold): compare the same
-    # field set the cross-backend artifact checks, plus the node state
-    for field in ("now", "halted", "halt_time", "msg_count", "overflow",
-                  "node_state", "ev_valid"):
-        da = np.asarray(getattr(dense, field))
-        sa = np.asarray(getattr(scatter, field))
-        if not np.array_equal(da, sa):
-            seed_idx = np.nonzero(
-                (da != sa).reshape(da.shape[0], -1).any(axis=1)
-            )[0][0]
-            raise DeterminismError(
-                f"{wl.name} dense-vs-scatter: field {field!r} diverged "
-                f"at seed index {int(seed_idx)} "
-                f"(seed {int(seeds[seed_idx])})"
-            )
+    variants = [("dense", False), ("scatter", False)]
+    if time32_eligible(wl, cfg):
+        # the int32 offset representation is a third value-identical
+        # lowering (make_step's ``time32``); cross it with both layouts
+        variants += [("dense", True), ("scatter", True)]
+    runs = {}
+    for layout, t32 in variants:
+        init = make_init(wl, cfg, time32=t32)
+        runs[(layout, t32)] = jax.jit(
+            make_run(wl, cfg, n_steps, layout=layout, time32=t32)
+        )(init(seeds))
+    base_key = ("dense", False)
+    base = runs[base_key]
+    for key, other in runs.items():
+        if key == base_key:
+            continue
+        what = f"{wl.name} {base_key}-vs-{key}"
+        compare_traces(base, other, what=what)
+        # the trace doesn't see everything (dropped-on-overflow events,
+        # a mis-masked state write after the last fold): compare the
+        # same field set the cross-backend artifact checks, plus the
+        # node state. ev_time is excluded: representations differ by
+        # design (absolute int64 vs rebased int32 offsets)
+        for field in ("now", "halted", "halt_time", "msg_count", "overflow",
+                      "node_state", "ev_valid"):
+            da = np.asarray(getattr(base, field))
+            sa = np.asarray(getattr(other, field))
+            if not np.array_equal(da, sa):
+                seed_idx = np.nonzero(
+                    (da != sa).reshape(da.shape[0], -1).any(axis=1)
+                )[0][0]
+                raise DeterminismError(
+                    f"{what}: field {field!r} diverged "
+                    f"at seed index {int(seed_idx)} "
+                    f"(seed {int(seeds[seed_idx])})"
+                )

@@ -118,4 +118,6 @@ def make_broadcast(
         state_width=4,
         handlers=(on_init, on_msg, on_ack, on_retx),
         max_emits=max(len(peers) + 3, 6),
+        # largest timer: chaos unclog at 'at + length' <= 100 ms + 400 ms
+        delay_bound_ns=max(retx_ns, 500_000_000),
     )

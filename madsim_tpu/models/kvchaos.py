@@ -266,5 +266,7 @@ def make_kvchaos(
         # on_init builds up to 5 rows (write/cretx + join/jretx + 2 chaos);
         # on_retx builds n_replicas+2
         max_emits=max(n_replicas + 2, 6),
+        # largest timer: chaos restart at 'at + revive' <= 300 ms + 600 ms
+        delay_bound_ns=max(retx_ns, client_retx_ns, 900_000_000),
         payload_words=2 if payload else 0,
     )

@@ -52,4 +52,6 @@ def make_microbench(
         state_width=4,
         handlers=(on_init, on_tick),
         max_emits=2,
+        # largest timer: the tick delay draw (time32 eligibility)
+        delay_bound_ns=delay_max_ns,
     )

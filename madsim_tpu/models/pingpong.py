@@ -62,4 +62,6 @@ def make_pingpong(rounds: int = 10, n_clients: int = 2) -> Workload:
         state_width=4,
         handlers=(on_init, on_ping, on_pong, on_done),
         max_emits=2,
+        # no user timers at all; sends ride latency draws only
+        delay_bound_ns=0,
     )

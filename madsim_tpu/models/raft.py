@@ -139,4 +139,6 @@ def make_raft(
         state_width=6,
         handlers=(on_init, on_timeout, on_reqvote, on_grant, on_heartbeat),
         max_emits=n_nodes + 1,
+        # largest timer: the election timeout draw (time32 eligibility)
+        delay_bound_ns=timeout_max_ns,
     )

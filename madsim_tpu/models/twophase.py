@@ -270,4 +270,6 @@ def make_twophase(
         # widest handlers: on_retx (2*P sends + 1 timer) and on_init
         # (P prepares + retx + hello + hretx + 3 chaos)
         max_emits=max(2 * n_parts + 1, n_parts + 6, 6),
+        # largest timer: chaos restart/resync at 'at + revive'
+        delay_bound_ns=max(retx_ns, 250_000_000 + revive_max_ns),
     )

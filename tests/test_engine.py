@@ -510,12 +510,80 @@ def test_restart_restores_initial_rows():
                                   "broadcast", "kvchaos"])
 def test_check_layouts_all_models(name):
     # the library form of the cross-backend check: dense and scatter
-    # lowerings must agree (traces + state) for every benchmark workload
-    from madsim_tpu.engine import EngineConfig, check_layouts
+    # lowerings must agree (traces + state) for every benchmark workload.
+    # Bench configs are time32-eligible, so this crosses the int32
+    # offset representation with both layouts too (4 variants)
+    from madsim_tpu.engine import EngineConfig, check_layouts, time32_eligible
     from madsim_tpu.models import BENCH_SPECS
 
     factory, cfg_kwargs, _seeds, _steps = BENCH_SPECS[name]
-    check_layouts(factory(), EngineConfig(**cfg_kwargs), np.arange(8), 150)
+    wl, cfg = factory(), EngineConfig(**cfg_kwargs)
+    assert time32_eligible(wl, cfg), "bench configs must allow int32 times"
+    check_layouts(wl, cfg, np.arange(8), 150)
+
+
+class TestTime32:
+    def test_forced_time32_on_ineligible_config_raises(self):
+        from madsim_tpu.engine import EngineConfig, make_step
+        from madsim_tpu.models import make_raft
+
+        # the default 10 s clog-backoff cap exceeds the int32 horizon
+        wl, cfg = make_raft(), EngineConfig(pool_size=48)
+        with pytest.raises(ValueError, match="not eligible"):
+            make_step(wl, cfg, time32=True)
+
+    def test_undeclared_delay_bound_is_ineligible(self):
+        from madsim_tpu.engine import EngineConfig, time32_eligible
+        from madsim_tpu.models import make_raft
+
+        wl = make_raft()
+        wl = type(wl)(**{**wl.__dict__, "delay_bound_ns": None})
+        assert not time32_eligible(
+            wl, EngineConfig(clog_backoff_max_ns=10_000_000)
+        )
+
+    def test_delay_past_horizon_counts_as_overflow(self):
+        # a handler lying about delay_bound_ns must be caught loudly:
+        # the emitted timer is clamped and counted into `overflow`
+        from madsim_tpu.engine import (
+            EngineConfig,
+            Workload,
+            make_init,
+            make_run,
+            user_kind,
+        )
+
+        def on_init(ctx):
+            eb = ctx.emits()
+            eb.after(3_000_000_000, user_kind(0), ctx.node)  # 3 s > 2^31 ns
+            return ctx.state, eb.build()
+
+        wl = Workload(
+            name="liar",
+            n_nodes=1,
+            state_width=1,
+            handlers=(on_init,),
+            max_emits=1,
+            delay_bound_ns=1_000,  # the lie
+        )
+        cfg = EngineConfig(pool_size=4, clog_backoff_max_ns=10_000_000)
+        out = jax.jit(make_run(wl, cfg, 3, time32=True))(
+            make_init(wl, cfg, time32=True)(np.arange(2, dtype=np.uint64))
+        )
+        assert int(np.asarray(out.overflow).sum()) >= 2
+
+    def test_representation_mismatch_is_loud(self):
+        # a state built under one time representation fed to a step
+        # built under the other (the checkpoint save/resume hazard)
+        # must raise at trace time, not silently misread offsets
+        from madsim_tpu.engine import EngineConfig, make_init, make_run
+        from madsim_tpu.models import BENCH_SPECS
+
+        factory, kw, _, _ = BENCH_SPECS["raft"]
+        wl, cfg = factory(), EngineConfig(**kw)
+        state = make_init(wl, cfg, time32=True)(np.arange(2, dtype=np.uint64))
+        with pytest.raises(TypeError, match="time32"):
+            jax.jit(make_run(wl, cfg, 3, time32=False))(state)
 
 
 def test_twophase_atomicity_under_chaos():

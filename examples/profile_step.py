@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from madsim_tpu.engine import EngineConfig, make_init, make_step
-from madsim_tpu.engine.core import _INF_NS
+from madsim_tpu.engine.core import _INF_NS, _meta_kind, _meta_node
 from madsim_tpu.engine.rng import PURPOSE_LATENCY, PURPOSE_POLL_COST, Draw
 from madsim_tpu.models import make_raft
 
@@ -116,11 +116,13 @@ def main():
         rows = jnp.arange(st.ev_time.shape[0])
         tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
         i = jnp.argmin(tmask, axis=1)
-        kind = st.ev_kind[rows, i]
-        dst = st.ev_node[rows, i]
+        meta = st.ev_meta[rows, i]
+        kind = _meta_kind(meta)
+        dst = _meta_node(meta)
+        dst_c = jnp.clip(dst, 0, st.node_state.shape[1] - 1)
         args = st.ev_args[rows, i]
-        nstate = st.node_state[rows, dst]
-        alive = st.alive[rows, dst]
+        nstate = st.node_state[rows, dst_c]
+        alive = st.alive[rows, dst_c]
         acc = (kind + dst + args.sum(-1) + nstate.sum(-1) + alive).astype(jnp.int64)
         return st.__class__(**{**st.__dict__, "now": st.now + acc})
 
@@ -128,24 +130,25 @@ def main():
 
     # 5. scatters: the emit-insertion writes (K slots into the E pool)
     def scatters_only(st):
-        def one(ev_valid, ev_time, ev_kind, ev_node, ev_args, stp):
+        def one(ev_valid, ev_time, ev_meta, ev_args, stp):
             free = jnp.flatnonzero(~ev_valid, size=k, fill_value=ev_valid.shape[0])
             e_valid = jnp.ones((k,), jnp.bool_)
             slot = free
             return (
                 ev_valid.at[slot].set(e_valid, mode="drop"),
-                ev_time.at[slot].set(jnp.full((k,), 7, jnp.int64), mode="drop"),
-                ev_kind.at[slot].set(jnp.full((k,), 1, jnp.int32), mode="drop"),
-                ev_node.at[slot].set(jnp.zeros((k,), jnp.int32), mode="drop"),
+                ev_time.at[slot].set(
+                    jnp.full((k,), 7, ev_time.dtype), mode="drop"
+                ),
+                ev_meta.at[slot].set(jnp.full((k,), 1, jnp.uint32), mode="drop"),
                 ev_args.at[slot].set(jnp.zeros((k, 4), jnp.int32), mode="drop"),
             )
 
-        ev_valid, ev_time, ev_kind, ev_node, ev_args = jax.vmap(one)(
-            st.ev_valid, st.ev_time, st.ev_kind, st.ev_node, st.ev_args, st.step
+        ev_valid, ev_time, ev_meta, ev_args = jax.vmap(one)(
+            st.ev_valid, st.ev_time, st.ev_meta, st.ev_args, st.step
         )
         return st.__class__(**{**st.__dict__, "ev_valid": ev_valid,
-                               "ev_time": ev_time, "ev_kind": ev_kind,
-                               "ev_node": ev_node, "ev_args": ev_args})
+                               "ev_time": ev_time, "ev_meta": ev_meta,
+                               "ev_args": ev_args})
 
     results["emit_scatters"] = timed("emit_scatters", scan_n(scatters_only), state)
 
@@ -156,7 +159,7 @@ def main():
     def place_only(st):
         def one(ev_valid, ev_time, stp):
             e_valid = jnp.ones((k,), jnp.bool_)
-            e_time = jnp.full((k,), 7, jnp.int64)
+            e_time = jnp.full((k,), 7, ev_time.dtype)
             free_rank = jnp.cumsum(~ev_valid) - 1
             pos = jnp.cumsum(e_valid.astype(jnp.int32)) - 1
             match = (

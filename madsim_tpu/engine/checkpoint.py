@@ -27,7 +27,10 @@ from .core import EngineConfig, SimState
 __all__ = ["save", "load"]
 
 _MANIFEST_KEY = "__madsim_manifest__"
-_FORMAT = 1
+# format 2: ev_kind/ev_node/ev_src/ev_retry merged into packed ev_meta
+# (core.py byte-layout note); format-1 checkpoints are rejected with the
+# designed mismatch error rather than a KeyError mid-load
+_FORMAT = 2
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

@@ -97,6 +97,60 @@ __all__ = [
 _INF_NS = np.int64(2**62)
 _INF_32 = np.int32(2**31 - 1)
 _T32_LIMIT = 2**31 - 1  # max future-event offset representable in int32
+
+# ---------------------------------------------------------------------------
+# ev_meta byte layout. The four small per-event fields travel as one
+# uint32 word — every per-slot pick and placement touches one array
+# instead of four, the dominant per-step cost on TPU (the placement
+# selects scale with the number of placed words, SCALING.md §3).
+#   byte 0: kind                  (engine kinds + user handlers <= 255)
+#   byte 1: target node + 1       (-1..n clipped; 0 = no node, n+1 = OOB)
+#   byte 2: source node + 1       (0 = timer/engine event)
+#   byte 3: clog-retry count, saturating at 255 (the backoff shift caps
+#           at 34, so saturation is behaviorally invisible)
+# Out-of-range kinds/nodes are clipped at pack time; every consumer
+# treats a clipped value exactly like the out-of-range original (a
+# no-match in the one-hots / in_range masks), so observable semantics
+# are unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _meta_pack(kind, node1, src1, retry):
+    return (
+        kind.astype(jnp.uint32)
+        | (node1.astype(jnp.uint32) << jnp.uint32(8))
+        | (src1.astype(jnp.uint32) << jnp.uint32(16))
+        | (retry.astype(jnp.uint32) << jnp.uint32(24))
+    )
+
+
+def _meta_kind(meta):
+    return (meta & jnp.uint32(0xFF)).astype(jnp.int32)
+
+
+def _meta_node(meta):
+    return ((meta >> jnp.uint32(8)) & jnp.uint32(0xFF)).astype(jnp.int32) - 1
+
+
+def _meta_src(meta):
+    return ((meta >> jnp.uint32(16)) & jnp.uint32(0xFF)).astype(jnp.int32) - 1
+
+
+def _meta_retry(meta):
+    return ((meta >> jnp.uint32(24)) & jnp.uint32(0xFF)).astype(jnp.int32)
+
+
+def _check_meta_ranges(wl: "Workload") -> None:
+    """ev_meta byte-range requirements — enforced wherever packing
+    happens (make_init and make_step), so no corrupt state can be built."""
+    if wl.n_nodes > 254:
+        raise ValueError(
+            f"n_nodes={wl.n_nodes} exceeds the meta byte range (254)"
+        )
+    if FIRST_USER_KIND + len(wl.handlers) > 255:
+        raise ValueError(
+            f"{len(wl.handlers)} handlers exceed the meta kind byte"
+        )
 _TRACE_PRIME = np.uint64(0x100000001B3)
 _TRACE_MIX = np.uint64(0x9E3779B97F4A7C15)
 
@@ -383,11 +437,9 @@ class SimState:
     ev_time: jnp.ndarray  # (E,) int64 absolute ns — or, under time32
     #                          (make_step), int32 offset from `now`
     ev_valid: jnp.ndarray  # (E,) bool
-    ev_kind: jnp.ndarray  # (E,) int32
-    ev_node: jnp.ndarray  # (E,) int32 target node
-    ev_src: jnp.ndarray  # (E,) int32 sender (-1 = timer/engine)
+    ev_meta: jnp.ndarray  # (E,) uint32 packed kind/node/src/retry (see
+    #                          the ev_meta byte-layout note above)
     ev_epoch: jnp.ndarray  # (E,) int32 target-node epoch at emit time
-    ev_retry: jnp.ndarray  # (E,) int32 clog-backoff retry count
     ev_args: jnp.ndarray  # (E,4) int32
     ev_pay: jnp.ndarray  # (E,W) int32 payload words (W=0 when disabled)
     # nodes
@@ -458,6 +510,7 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     if e < n:
         raise ValueError(f"pool_size={e} must hold at least one event per node ({n})")
+    _check_meta_ranges(wl)
     del k
     w = wl.payload_words
     tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
@@ -469,6 +522,13 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
         ev_kind = jnp.full((e,), KIND_NOP, jnp.int32)
         ev_kind = ev_kind.at[:n].set(FIRST_USER_KIND)
         ev_node = jnp.zeros((e,), jnp.int32).at[:n].set(jnp.arange(n, dtype=jnp.int32))
+        # src = -1 (timer), retry = 0 for every initial on_init event
+        ev_meta = _meta_pack(
+            ev_kind,
+            ev_node + 1,
+            jnp.zeros((e,), jnp.int32),
+            jnp.zeros((e,), jnp.int32),
+        )
         return SimState(
             seed=seed,
             now=jnp.int64(0),
@@ -480,11 +540,8 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
             msg_count=jnp.int64(0),
             ev_time=jnp.zeros((e,), tdtype),
             ev_valid=ev_valid,
-            ev_kind=ev_kind,
-            ev_node=ev_node,
-            ev_src=jnp.full((e,), -1, jnp.int32),
+            ev_meta=ev_meta,
             ev_epoch=jnp.zeros((e,), jnp.int32),
-            ev_retry=jnp.zeros((e,), jnp.int32),
             ev_args=jnp.zeros((e, 4), jnp.int32),
             ev_pay=jnp.zeros((e, w), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
@@ -564,6 +621,7 @@ def make_step(
     w = wl.payload_words
     init_rows = jnp.asarray(wl.initial_state())
     n_user = len(wl.handlers)
+    _check_meta_ranges(wl)
     if layout is None:
         layout = "scatter" if jax.default_backend() == "cpu" else "dense"
     if layout not in ("dense", "scatter"):
@@ -660,9 +718,10 @@ def make_step(
         over_limit = ev_t > time_limit
         active = has_event & ~st.halted & ~over_limit
 
-        kind = pick_slot(st.ev_kind)
-        dst = pick_slot(st.ev_node)
-        src = pick_slot(st.ev_src)
+        meta_i = pick_slot(st.ev_meta)
+        kind = _meta_kind(meta_i)
+        dst = _meta_node(meta_i)
+        src = _meta_src(meta_i)
         args = pick_slot(st.ev_args)
         ev_epoch_i = pick_slot(st.ev_epoch)
         pay_i = pick_slot(st.ev_pay)
@@ -719,7 +778,7 @@ def make_step(
         # scatter to a serial loop — it measured as 96% of step wall
         # time, examples/profile_step.py); scatter: .at[].set, the
         # faster CPU lowering. Same values either way.
-        retries = pick_slot(st.ev_retry)
+        retries = _meta_retry(meta_i)
         shift = jnp.minimum(retries, jnp.int32(34)).astype(jnp.int64)
         backoff = jnp.minimum(
             jnp.int64(cfg.clog_backoff_min_ns) << shift,
@@ -741,17 +800,22 @@ def make_step(
             ev_time_reb = st.ev_time
             back_t = now + backoff
             old_t = ev_time_i
+        # retry byte bump, saturating (shift caps at 34 so >=255 retries
+        # behave identically); the other three meta bytes are unchanged
+        meta_bumped = (meta_i & jnp.uint32(0x00FFFFFF)) | (
+            jnp.minimum(retries + 1, 255).astype(jnp.uint32) << jnp.uint32(24)
+        )
         if dense:
             ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
             ev_time_mid = jnp.where(is_popped & resched, back_t, ev_time_reb)
-            ev_retry_mid = jnp.where(is_popped & resched, retries + 1, st.ev_retry)
+            ev_meta_mid = jnp.where(is_popped & resched, meta_bumped, st.ev_meta)
         else:
             ev_valid_mid = st.ev_valid.at[i].set(resched)
             ev_time_mid = ev_time_reb.at[i].set(
                 jnp.where(resched, back_t, old_t)
             )
-            ev_retry_mid = st.ev_retry.at[i].set(
-                jnp.where(resched, retries + 1, retries)
+            ev_meta_mid = st.ev_meta.at[i].set(
+                jnp.where(resched, meta_bumped, meta_i)
             )
 
         # ---- dispatch: user handlers via lax.switch; engine kinds are
@@ -898,6 +962,23 @@ def make_step(
         e_src = jnp.where(em.send, dst, jnp.int32(-1))
         # engine-kind events bypass the epoch gate; keep their slot epoch 0
         e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
+        # pack the four small fields into the meta word (layout at top of
+        # file); kind/dst clip to the byte ranges — out-of-range values
+        # already matched nothing downstream, and clipping keeps them
+        # matching nothing
+        # negative kinds were engine kinds matching no KIND_* constant
+        # (a no-op); map them to KIND_NOP, the in-byte value with that
+        # exact behavior. Kinds > 255 already dispatched the clamped
+        # last user handler and still do at 255 (the handler-count
+        # guard keeps 255 above every valid kind). For these two
+        # out-of-contract inputs only, the *trace* records the mapped
+        # kind rather than the raw one
+        e_meta = _meta_pack(
+            jnp.where(em.kind < 0, KIND_NOP, jnp.minimum(em.kind, 255)),
+            jnp.clip(em.dst, -1, n) + 1,
+            jnp.clip(e_src, -1, n) + 1,
+            jnp.zeros((em.kind.shape[0],), jnp.int32),
+        )
 
         # compact placement: the j-th *valid* emit takes the j-th free
         # slot (pool order), so sparse emit patterns (gated `when` rows)
@@ -936,11 +1017,8 @@ def make_step(
 
             ev_valid = ev_valid_mid | match_any
             ev_time = place(e_time, ev_time_mid)
-            ev_kind = place(em.kind, st.ev_kind)
-            ev_node = place(em.dst, st.ev_node)
-            ev_src = place(e_src, st.ev_src)
+            ev_meta = place(e_meta, ev_meta_mid)
             ev_epoch = place(e_epoch, st.ev_epoch)
-            ev_retry = place(jnp.zeros((k1,), jnp.int32), ev_retry_mid)
             ev_args = place(em.args, st.ev_args)
             ev_pay = place(em.pay, st.ev_pay)
         else:
@@ -952,13 +1030,8 @@ def make_step(
             overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32) + n_delay_over
             ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
             ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
-            ev_kind = st.ev_kind.at[slot].set(em.kind, mode="drop")
-            ev_node = st.ev_node.at[slot].set(em.dst, mode="drop")
-            ev_src = st.ev_src.at[slot].set(e_src, mode="drop")
+            ev_meta = ev_meta_mid.at[slot].set(e_meta, mode="drop")
             ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
-            ev_retry = ev_retry_mid.at[slot].set(
-                jnp.zeros((k1,), jnp.int32), mode="drop"
-            )
             ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
             ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
 
@@ -979,11 +1052,8 @@ def make_step(
             msg_count=msg_count,
             ev_time=ev_time,
             ev_valid=ev_valid,
-            ev_kind=ev_kind,
-            ev_node=ev_node,
-            ev_src=ev_src,
+            ev_meta=ev_meta,
             ev_epoch=ev_epoch,
-            ev_retry=ev_retry,
             ev_args=ev_args,
             ev_pay=ev_pay,
             alive=alive,

@@ -46,7 +46,7 @@ def run_sharded(wl, cfg, seeds, n_steps, devices):
 def assert_states_equal(a, b):
     for name in (
         "trace", "now", "step", "halted", "halt_time", "overflow",
-        "msg_count", "node_state", "ev_time", "ev_valid", "ev_kind",
+        "msg_count", "node_state", "ev_time", "ev_valid", "ev_meta",
         "alive", "epoch", "clog",
     ):
         av = np.asarray(getattr(a, name))

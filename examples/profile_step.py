@@ -140,7 +140,9 @@ def main():
                     jnp.full((k,), 7, ev_time.dtype), mode="drop"
                 ),
                 ev_meta.at[slot].set(jnp.full((k,), 1, jnp.uint32), mode="drop"),
-                ev_args.at[slot].set(jnp.zeros((k, 4), jnp.int32), mode="drop"),
+                ev_args.at[slot].set(
+                    jnp.zeros((k, ev_args.shape[-1]), jnp.int32), mode="drop"
+                ),
             )
 
         ev_valid, ev_time, ev_meta, ev_args = jax.vmap(one)(

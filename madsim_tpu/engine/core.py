@@ -242,14 +242,14 @@ class Emits:
     pay: jnp.ndarray  # (K,W) int32 payload words (W = Workload.payload_words)
 
     @staticmethod
-    def none(k: int, w: int = 0) -> "Emits":
+    def none(k: int, w: int = 0, a: int = 4) -> "Emits":
         return Emits(
             valid=jnp.zeros((k,), jnp.bool_),
             send=jnp.zeros((k,), jnp.bool_),
             kind=jnp.zeros((k,), jnp.int32),
             dst=jnp.zeros((k,), jnp.int32),
             delay=jnp.zeros((k,), jnp.int64),
-            args=jnp.zeros((k, 4), jnp.int32),
+            args=jnp.zeros((k, a), jnp.int32),
             pay=jnp.zeros((k, w), jnp.int32),
         )
 
@@ -261,9 +261,10 @@ class EmitBuilder:
     flag is the traced per-seed condition making an emit conditional.
     """
 
-    def __init__(self, k: int, w: int = 0):
+    def __init__(self, k: int, w: int = 0, a: int = 4):
         self._k = k
         self._w = w
+        self._a = a
         self._rows: list[tuple] = []
 
     def _push(self, send, kind, dst, delay, args, when, pay=()):
@@ -272,7 +273,11 @@ class EmitBuilder:
                 f"handler emits more than max_emits={self._k} events; "
                 f"raise Workload.max_emits"
             )
-        a = list(args) + [0] * (4 - len(args))
+        if len(args) > self._a:
+            raise ValueError(
+                f"{len(args)} event args exceed Workload.args_words={self._a}"
+            )
+        a = list(args) + [0] * (self._a - len(args))
         p = list(pay)
         if len(p) > self._w:
             raise ValueError(
@@ -319,7 +324,7 @@ class EmitBuilder:
     def build(self) -> Emits:
         k, w = self._k, self._w
         if not self._rows:
-            return Emits.none(k, w)
+            return Emits.none(k, w, self._a)
         pad = k - len(self._rows)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_r) in self._rows]
         send = [jnp.asarray(s, jnp.bool_) for (_w, s, *_r) in self._rows]
@@ -345,7 +350,7 @@ class EmitBuilder:
             kind=jnp.stack(kind + [z32] * pad),
             dst=jnp.stack(dst + [z32] * pad),
             delay=jnp.stack(delay + [jnp.int64(0)] * pad),
-            args=jnp.stack(args + [jnp.zeros((4,), jnp.int32)] * pad),
+            args=jnp.stack(args + [jnp.zeros((self._a,), jnp.int32)] * pad),
             pay=jnp.stack(pay + [jnp.zeros((w,), jnp.int32)] * pad),
         )
 
@@ -363,9 +368,10 @@ class HandlerCtx:
     max_emits: int
     payload: jnp.ndarray = None  # (W,) int32 — the event's payload words
     payload_words: int = 0
+    args_words: int = 4
 
     def emits(self) -> EmitBuilder:
-        return EmitBuilder(self.max_emits, self.payload_words)
+        return EmitBuilder(self.max_emits, self.payload_words, self.args_words)
 
 
 Handler = Callable[[HandlerCtx], tuple]
@@ -392,6 +398,11 @@ class Workload:
     # payload lifetime equals event lifetime, so the arena IS the event
     # pool — no separate allocator, no leaks
     payload_words: int = 0
+    # width of the per-event args vector (int32 words). Engine kinds use
+    # args[0:2] (kill/clog targets), so 2 is the floor; shrink from the
+    # default 4 when no handler reads args[2:] — the args arena is a
+    # per-step placement cost like every pool field
+    args_words: int = 4
     # largest timer delay (ns) any handler can pass to EmitBuilder.after.
     # Declaring it (together with config bounds, see time32_eligible)
     # unlocks the int32 event-time representation on accelerators; None
@@ -407,6 +418,11 @@ class Workload:
         # bleed toward PURPOSE_USER and correlate "independent" draws.
         # -1: the engine appends one internal row (the restart re-init
         # event) after the user slots
+        if not (2 <= self.args_words <= 4):
+            raise ValueError(
+                f"args_words={self.args_words} must be in [2, 4] "
+                f"(engine kinds read args[0:2])"
+            )
         limit = PURPOSE_LOSS - PURPOSE_LATENCY - 1
         if self.max_emits > limit:
             raise ValueError(
@@ -542,7 +558,7 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
             ev_valid=ev_valid,
             ev_meta=ev_meta,
             ev_epoch=jnp.zeros((e,), jnp.int32),
-            ev_args=jnp.zeros((e, 4), jnp.int32),
+            ev_args=jnp.zeros((e, wl.args_words), jnp.int32),
             ev_pay=jnp.zeros((e, w), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
             paused=jnp.zeros((n,), jnp.bool_),
@@ -569,9 +585,10 @@ def _trace_fold(trace, now, kind, node, args, pay=None):
     h = h ^ (kind.astype(jnp.uint64) << jnp.uint64(32))
     h = h ^ (node.astype(jnp.uint64) << jnp.uint64(40))
     a = args.astype(jnp.uint32).astype(jnp.uint64)
-    h = h ^ a[0] ^ (a[1] << jnp.uint64(8)) ^ (a[2] << jnp.uint64(16)) ^ (
-        a[3] << jnp.uint64(24)
-    )
+    # missing high words were always emitted as zeros, so folding only
+    # the declared args_words is value-identical to the 4-wide fold
+    for j in range(args.shape[0]):
+        h = h ^ (a[j] << jnp.uint64(8 * j))
     if pay is not None and pay.shape[0] > 0:
         # payload words participate in the trace so a payload divergence
         # between backends is caught; W=0 keeps pre-payload traces intact
@@ -619,6 +636,7 @@ def make_step(
     n = wl.n_nodes
     k = wl.max_emits
     w = wl.payload_words
+    aw = wl.args_words
     init_rows = jnp.asarray(wl.initial_state())
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
@@ -650,6 +668,7 @@ def make_step(
             max_emits=k,
             payload=pay,
             payload_words=w,
+            args_words=aw,
         )
 
     def _user_branch(handler):
@@ -829,7 +848,7 @@ def make_step(
             user_state, uem = lax.switch(user_idx, user_branches, operand)
         else:
             # chaos-only workload: no user branches to run
-            user_state, uem = state_row, Emits.none(k, w)
+            user_state, uem = state_row, Emits.none(k, w, aw)
         user_dispatch = dispatch & ~is_engine
 
         # ---- apply node-state update (an OOB dst matches no row in the
@@ -902,7 +921,7 @@ def make_step(
             ),
             dst=jnp.concatenate([uem.dst, a0[None]]),
             delay=jnp.concatenate([uem.delay, jnp.zeros((1,), jnp.int64)]),
-            args=jnp.concatenate([uem.args, jnp.zeros((1, 4), jnp.int32)]),
+            args=jnp.concatenate([uem.args, jnp.zeros((1, aw), jnp.int32)]),
             pay=jnp.concatenate([uem.pay, jnp.zeros((1, w), jnp.int32)]),
         )
         slot_ix = jnp.arange(k + 1, dtype=jnp.uint32)  # +1: the restart row

@@ -120,4 +120,6 @@ def make_broadcast(
         max_emits=max(len(peers) + 3, 6),
         # largest timer: chaos unclog at 'at + length' <= 100 ms + 400 ms
         delay_bound_ns=max(retx_ns, 500_000_000),
+        # handlers read args[0:2] (seq / clog pair)
+        args_words=2,
     )

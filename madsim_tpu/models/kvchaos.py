@@ -268,5 +268,7 @@ def make_kvchaos(
         max_emits=max(n_replicas + 2, 6),
         # largest timer: chaos restart at 'at + revive' <= 300 ms + 600 ms
         delay_bound_ns=max(retx_ns, client_retx_ns, 900_000_000),
+        # handlers read args[0:2] (seq/who)
+        args_words=2,
         payload_words=2 if payload else 0,
     )

@@ -54,4 +54,6 @@ def make_microbench(
         max_emits=2,
         # largest timer: the tick delay draw (time32 eligibility)
         delay_bound_ns=delay_max_ns,
+        # no handler reads past args[1]
+        args_words=2,
     )

@@ -64,4 +64,6 @@ def make_pingpong(rounds: int = 10, n_clients: int = 2) -> Workload:
         max_emits=2,
         # no user timers at all; sends ride latency draws only
         delay_bound_ns=0,
+        # handlers read args[0:2] (round, client)
+        args_words=2,
     )

@@ -141,4 +141,6 @@ def make_raft(
         max_emits=n_nodes + 1,
         # largest timer: the election timeout draw (time32 eligibility)
         delay_bound_ns=timeout_max_ns,
+        # handlers read args[0:2] (term/candidate/seq)
+        args_words=2,
     )

@@ -272,4 +272,6 @@ def make_twophase(
         max_emits=max(2 * n_parts + 1, n_parts + 6, 6),
         # largest timer: chaos restart/resync at 'at + revive'
         delay_bound_ns=max(retx_ns, 250_000_000 + revive_max_ns),
+        # on_decision reads args[2]
+        args_words=3,
     )

@@ -1,9 +1,14 @@
 """Batch-size scaling sweep: sim-s/s across seeds x the five configs.
 
 Produces the SCALING.md evidence: for each benchmark config, run the
-bench measurement at seed counts 1k/4k/16k/65k (and 256k for raft) and
-record simulated-seconds/sec plus wall per step. Best-of-3 per cell
-(the remote-TPU dispatch path has multi-100ms jitter).
+bench measurement at seed counts 1k/4k/16k/65k (256k extra for raft; a
+single-seed cell extra for pingpong, BASELINE config 1) and record
+simulated-seconds/sec plus wall per step. Uses the same compacted
+runner and compute/assemble timing seam as bench.py; it differs from
+the headline artifact in repeat policy (best-of-3 every cell, vs
+bench.py's best-of-5 on accelerators / single run on CPU) and in
+reporting cells with a nonzero overflow count instead of refusing
+them — check the `overflow` field before quoting a cell.
 
 Usage: python examples/scaling_sweep.py [out.json]
 """
@@ -18,49 +23,39 @@ import numpy as np
 
 import jax
 
-from madsim_tpu.engine import EngineConfig, make_init, make_run_while
-from madsim_tpu.models import (
-    make_broadcast,
-    make_kvchaos,
-    make_microbench,
-    make_pingpong,
-    make_raft,
-)
+from madsim_tpu.engine import EngineConfig, make_init, make_run_compacted
+from madsim_tpu.models import BENCH_SPECS
 
 SEED_COUNTS = [1024, 4096, 16384, 65536]
-
-CONFIGS = {
-    "raft": (lambda: make_raft(), dict(pool_size=48, loss_p=0.02), 600),
-    "microbench": (lambda: make_microbench(), dict(pool_size=32), 1100),
-    "broadcast": (lambda: make_broadcast(), dict(pool_size=48, loss_p=0.05), 500),
-    "kvchaos": (lambda: make_kvchaos(), dict(pool_size=48, loss_p=0.02), 900),
-    "pingpong": (lambda: make_pingpong(), dict(pool_size=32), 300),
-}
 
 
 def measure(name, mk, cfg_kw, max_steps, n_seeds):
     wl = mk()
     cfg = EngineConfig(**cfg_kw)
     init = make_init(wl, cfg)
-    run = jax.jit(make_run_while(wl, cfg, max_steps), donate_argnums=0)
-    jax.block_until_ready(run(init(np.arange(n_seeds, dtype=np.uint64))))
+    run = make_run_compacted(
+        wl, cfg, max_steps, min_size=2048,
+        fields=("now", "overflow", "halted", "step"),
+    )
+    jax.block_until_ready(run.compute(init(np.arange(n_seeds, dtype=np.uint64))))
     best_wall, best = float("inf"), None
     for _ in range(3):
         state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
         t0 = time.perf_counter()
-        out = jax.block_until_ready(run(state))
+        banked = jax.block_until_ready(run.compute(state))
         wall = time.perf_counter() - t0
         if wall < best_wall:
-            best_wall, best = wall, out
-    sim_s = float(np.asarray(best.now, dtype=np.float64).sum() / 1e9)
+            best_wall, best = wall, banked
+    out = run.assemble(best)
+    sim_s = float(np.asarray(out.now, dtype=np.float64).sum() / 1e9)
     rec = {
         "config": name,
         "n_seeds": n_seeds,
         "wall_s": round(best_wall, 4),
         "sim_s_per_s": round(sim_s / best_wall, 1),
-        "overflow": int(np.asarray(best.overflow).sum()),
-        "all_halted": bool(np.all(np.asarray(best.halted))),
-        "steps": int(np.asarray(best.step).max()),
+        "overflow": int(np.asarray(out.overflow).sum()),
+        "all_halted": bool(np.all(np.asarray(out.halted))),
+        "steps": int(np.asarray(out.step).max()),
     }
     print(json.dumps(rec), flush=True)
     return rec
@@ -70,8 +65,10 @@ def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else "SCALING_SWEEP.json"
     platform = jax.devices()[0].platform
     rows = []
-    for name, (mk, cfg_kw, max_steps) in CONFIGS.items():
+    for name, (mk, cfg_kw, _spec_seeds, max_steps) in BENCH_SPECS.items():
         counts = SEED_COUNTS + ([262144] if name == "raft" else [])
+        if name == "pingpong":
+            counts = [1] + counts  # BASELINE config 1 is single-seed
         for s in counts:
             rows.append(measure(name, mk, cfg_kw, max_steps, s))
     doc = {"platform": platform, "rows": rows}

@@ -116,10 +116,14 @@ def make_run_compacted(
         out["_idx"] = idx
         return out
 
-    def compiled(state: SimState):
+    def compiled(state: SimState, idx_offset=0):
+        """The phase program. Shapes are static per input size, so the
+        same traced function serves the full batch (make_run_compacted)
+        or one device's shard (parallel.shard_run_compacted, which
+        passes the shard's global row offset as ``idx_offset``)."""
         s0 = state.seed.shape[0]
         sizes = _phase_sizes(s0, shrink, min_size)
-        idx = jnp.arange(s0, dtype=jnp.int32)
+        idx = jnp.arange(s0, dtype=jnp.int32) + jnp.asarray(idx_offset, jnp.int32)
         steps = jnp.int64(0)
         st = state
         banked = []
@@ -177,4 +181,6 @@ def make_run_compacted(
     # host read also happened after the timed region
     run.compute = jitted
     run.assemble = assemble
+    # sharding seam: the raw phase program, for parallel.shard_run_compacted
+    run.phases = compiled
     return run

@@ -21,7 +21,13 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "seed_sharding", "shard_state", "shard_over_seeds"]
+__all__ = [
+    "make_mesh",
+    "seed_sharding",
+    "shard_state",
+    "shard_over_seeds",
+    "shard_run_compacted",
+]
 
 
 def make_mesh(devices=None, hosts: int | None = None) -> Mesh:
@@ -67,3 +73,76 @@ def shard_over_seeds(fn, mesh: Mesh):
     # a single sharding is a valid pytree prefix: it broadcasts to every
     # leaf of the SimState, all of which lead with the seed axis
     return jax.jit(fn, in_shardings=sh, out_shardings=sh)
+
+
+def shard_run_compacted(
+    wl,
+    cfg,
+    max_steps: int,
+    mesh: Mesh,
+    layout: str | None = None,
+    time32: bool | None = None,
+    shrink: int = 4,
+    min_size: int = 2048,
+    fields: tuple | None = None,
+):
+    """Multi-chip form of :func:`engine.make_run_compacted`.
+
+    ``shard_map`` runs the whole phase program *per device*: each chip
+    compacts its local seed shard independently (its while_loops trip
+    on local live counts), so there is zero cross-device traffic in the
+    hot loop — the reference's one-thread-per-seed "finished seeds stop
+    consuming CPU" economy, at mesh scale. Local phase boundaries fall
+    at different steps than a global run's would, but rows are
+    independent, so per-seed results are bit-identical to the unsharded
+    runner (tests/test_parallel.py asserts it).
+
+    Returns ``run(state) -> SimpleNamespace`` of per-original-seed
+    numpy arrays, like the single-device runner. ``state`` should be
+    placed with :func:`shard_state` (an unsharded state works too — jit
+    reshards it to the declared input sharding).
+    """
+    from ..engine import compact as _compact
+
+    kw = {} if fields is None else {"fields": fields}
+    base = _compact.make_run_compacted(
+        wl, cfg, max_steps, layout, time32, shrink=shrink,
+        min_size=min_size, **kw,
+    )
+    n_dev = mesh.devices.size
+    spec = P(mesh.axis_names)
+
+    def local(state):
+        # global row offset of this device's shard: axis_index over the
+        # full axis tuple is the major-order linearized device id, the
+        # same order seed_sharding splits the seed axis in — works for
+        # any mesh rank
+        dev = jax.lax.axis_index(mesh.axis_names)
+        local_rows = state.seed.shape[0]
+        return base.phases(state, idx_offset=dev * local_rows)
+
+    # check_vma=False: handler branches legitimately mix mesh-constant
+    # emits (static rows from EmitBuilder) with shard-varying values;
+    # the varying-axes checker would reject those lax.switch branches.
+    # Correctness is asserted value-wise instead (sharded == unsharded,
+    # tests/test_parallel.py)
+    sharded = jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        )
+    )
+
+    def compute(state):
+        if state.seed.shape[0] % n_dev:
+            raise ValueError(
+                f"{state.seed.shape[0]} seeds do not split over "
+                f"{n_dev} devices"
+            )
+        return sharded(state)
+
+    def run(state):
+        return base.assemble(jax.block_until_ready(compute(state)))
+
+    run.compute = compute
+    run.assemble = base.assemble
+    return run

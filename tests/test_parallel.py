@@ -121,3 +121,48 @@ def test_make_mesh_shapes():
     mesh2 = make_mesh(jax.devices(), hosts=2)
     assert mesh2.shape["host"] == 2
     assert mesh2.shape["chip"] == jax.device_count() // 2
+
+
+@pytest.mark.parametrize("name", ["raft", "kvchaos"])
+def test_shard_run_compacted_equals_unsharded(name):
+    # per-device local compaction: phase boundaries fall at different
+    # steps than the global runner's, but rows are independent, so
+    # per-seed results must be bit-identical to both the unsharded
+    # compactor and the lockstep loop
+    from madsim_tpu.engine import make_run_compacted
+    from madsim_tpu.engine.compact import RESULT_FIELDS
+    from madsim_tpu.models import BENCH_SPECS
+    from madsim_tpu.parallel import shard_run_compacted
+
+    factory, kw, _, _ = BENCH_SPECS[name]
+    wl, cfg = factory(), EngineConfig(**kw)
+    seeds = np.arange(128, dtype=np.uint64)
+    init = make_init(wl, cfg)
+    ref = jax.block_until_ready(jax.jit(make_run_while(wl, cfg, 2000))(init(seeds)))
+    solo = make_run_compacted(wl, cfg, 2000, shrink=2, min_size=4)(init(seeds))
+    mesh = make_mesh(jax.devices())
+    sharded = shard_run_compacted(
+        wl, cfg, 2000, mesh, shrink=2, min_size=4
+    )(shard_state(init(seeds), mesh))
+    for f in RESULT_FIELDS:
+        if f == "step":
+            continue  # documented divergence (engine/compact.py)
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), getattr(sharded, f), err_msg=f
+        )
+        np.testing.assert_array_equal(
+            getattr(solo, f), getattr(sharded, f), err_msg=f
+        )
+
+
+def test_shard_run_compacted_rejects_uneven_split():
+    from madsim_tpu.models import BENCH_SPECS
+    from madsim_tpu.parallel import shard_run_compacted
+
+    factory, kw, _, _ = BENCH_SPECS["raft"]
+    wl, cfg = factory(), EngineConfig(**kw)
+    mesh = make_mesh(jax.devices())
+    run = shard_run_compacted(wl, cfg, 100, mesh, min_size=4)
+    state = make_init(wl, cfg)(np.arange(12, dtype=np.uint64))
+    with pytest.raises(ValueError, match="do not split"):
+        run(state)

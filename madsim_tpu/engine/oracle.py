@@ -32,6 +32,7 @@ WORKLOAD_IDS = {
     "kvchaos": 4,
     "kvchaos-payload": 4,  # same C++ workload; payload flag via set_params
     "twophase": 5,
+    "raftlog": 6,
 }
 
 _lib = None
@@ -124,6 +125,18 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
             ctypes.c_int32(1 if wl.payload_words else 0),
         )
+    elif wl.name == "raftlog":
+        rc = lib.oracle_set_raftlog(
+            ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
+            ctypes.c_int32(model_kwargs.get("n_writes", 4)),
+            ctypes.c_int64(model_kwargs.get("timeout_min_ns", 150_000_000)),
+            ctypes.c_int64(model_kwargs.get("timeout_max_ns", 300_000_000)),
+            ctypes.c_int64(model_kwargs.get("propose_ns", 20_000_000)),
+            ctypes.c_int64(model_kwargs.get("retx_ns", 60_000_000)),
+            ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
+        )
+        if rc:
+            raise ValueError("oracle payload arena caps n_writes at 4")
     else:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")
 

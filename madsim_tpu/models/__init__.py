@@ -14,12 +14,16 @@ five cover the benchmark configs in BASELINE.md:
                    majority-durability invariant
   6. twophase    — two-phase commit with stored votes, phase-aware
                    retransmits and participant crash/recovery
+  7. raftlog     — raft log replication (single-inflight AppendEntries
+                   with full-prefix install, lexicographic vote checks,
+                   win-time re-stamp) under leader-crash chaos
 """
 
 from .microbench import make_microbench  # noqa: F401
 from .pingpong import make_pingpong  # noqa: F401
 from .broadcast import make_broadcast  # noqa: F401
 from .raft import make_raft  # noqa: F401
+from .raftlog import make_raftlog  # noqa: F401
 from .kvchaos import make_kvchaos  # noqa: F401
 from .twophase import make_twophase  # noqa: F401
 

@@ -1,0 +1,314 @@
+"""Raft log replication under leader-crash chaos — the MadRaft shape.
+
+Extends the election-only north-star workload (models/raft.py) to the
+full replication loop the reference ecosystem's flagship DST target
+(MadRaft) exercises: an elected leader proposes ``n_writes`` entries
+one at a time, replicates them with AppendEntries, commits each on a
+majority of acks, and the seed optionally schedules a node kill (often
+the leader) plus a later restart mid-stream. The instance halts when
+the final entry commits; the test-checkable safety invariant is the
+raft one: **every committed entry is present, in order and with equal
+values, on a majority of nodes at halt** — across elections, crashes,
+packet loss and partition-grade delays.
+
+Protocol simplifications, chosen to keep the state machine dense while
+preserving the real safety argument:
+
+* **Single inflight entry** — entry ``i+1`` is proposed only after
+  ``i`` commits, so AppendEntries can carry the sender's *entire* log
+  prefix in the event payload arena and followers adopt it wholesale
+  (no nextIndex backtracking; a restarted node is caught up by the
+  first retransmission it hears).
+* **Vote check** is the real lexicographic up-to-date rule: grant only
+  if the candidate's (last-log term, log length) >= the voter's.
+* **Win-time re-stamp** — a new leader re-stamps its uncommitted
+  suffix with its current term before re-replicating. Acks therefore
+  always cover a log whose last term is the leader's own, which closes
+  raft's "figure 8" hazard (committing an old-term entry by counting
+  current-term acks) without no-op filler entries: any later winner
+  must out-vote a majority holding the committed (term, length), and
+  only extensions of the committing leader's log can do that.
+
+Log entries pack as value | term << 8 in one int32 state word.
+
+State row: [role, term, voted_term, votes, timer_seq, log_len,
+            commit, ack_mask, log_0 .. log_{W-1}]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+
+_H_INIT = 0
+_H_TIMEOUT = 1  # args = (timer_seq,)
+_H_REQVOTE = 2  # args = (term, candidate, cand_loglen, cand_lastterm)
+_H_GRANT = 3  # args = (term,)
+_H_APPEND = 4  # args = (term, idx, leader_commit, leader); pay = full log
+_H_ACKAPP = 5  # args = (term, idx, follower)
+_H_PROPOSE = 6  # leader propose timer; args = (term,)
+_H_RETX = 7  # leader retransmit timer; args = (term,)
+
+ROLE, TERM, VOTED, VOTES, TSEQ, LOGLEN, COMMIT, ACKS = range(8)
+LOG0 = 8
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+_P_TIMEOUT = 0
+_P_VALUE = 1
+_P_KILL_AT = 2
+_P_KILL_WHO = 3
+_P_REVIVE = 4
+
+
+def _entry_term(e):
+    return (e >> jnp.int32(8)) & jnp.int32(0xFF)
+
+
+def make_raftlog(
+    n_nodes: int = 5,
+    n_writes: int = 4,
+    timeout_min_ns: int = 150_000_000,
+    timeout_max_ns: int = 300_000_000,
+    propose_ns: int = 20_000_000,
+    retx_ns: int = 60_000_000,
+    chaos: bool = True,
+) -> Workload:
+    majority = n_nodes // 2 + 1
+    nodes = list(range(n_nodes))
+    w = n_writes
+    width = LOG0 + w
+
+    def _lastterm(st):
+        """Term of the last log entry (0 for an empty log)."""
+        ll = st[LOGLEN]
+        acc = jnp.int32(0)
+        for j in range(w):
+            acc = jnp.where(ll == j + 1, _entry_term(st[LOG0 + j]), acc)
+        return acc
+
+    def _arm_election(ctx, eb, new_seq, when):
+        d = ctx.draw.user_int(timeout_min_ns, timeout_max_ns, _P_TIMEOUT)
+        eb.after(d, user_kind(_H_TIMEOUT), ctx.node, (new_seq,), when=when)
+
+    def _log_payload(st):
+        return tuple(st[LOG0 + j] for j in range(w))
+
+    def _send_appends(ctx, eb, st, term, when):
+        """Replicate the sender's full log (install-style) to every peer."""
+        idx = st[LOGLEN] - 1
+        pay = _log_payload(st)
+        for p in nodes:
+            eb.send(
+                p,
+                user_kind(_H_APPEND),
+                (term, idx, st[COMMIT], ctx.node),
+                when=when & (jnp.int32(p) != ctx.node),
+                pay=pay,
+            )
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        _arm_election(ctx, eb, jnp.int32(1), True)
+        if chaos:
+            # node 0's t=0 init schedules the seed's chaos plan (exactly
+            # once per run: restarted nodes re-run on_init, but only the
+            # epoch-0 instance of node 0 exists at t=0; later re-inits
+            # see now > 0)
+            first = (ctx.node == jnp.int32(0)) & (ctx.now == 0)
+            who = ctx.draw.user_int(0, n_nodes, _P_KILL_WHO).astype(jnp.int32)
+            at = ctx.draw.user_int(200_000_000, 500_000_000, _P_KILL_AT)
+            revive = ctx.draw.user_int(100_000_000, 600_000_000, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=first)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=first)
+        new = ctx.state.at[TSEQ].set(1)
+        return new, eb.build()
+
+    def on_timeout(ctx):
+        st = ctx.state
+        fire = (ctx.args[0] == st[TSEQ]) & (st[ROLE] != jnp.int32(LEADER))
+        term = st[TERM] + 1
+        new = jnp.where(
+            fire,
+            st.at[ROLE].set(CANDIDATE).at[TERM].set(term).at[VOTED].set(term)
+            .at[VOTES].set(1).at[TSEQ].set(st[TSEQ] + 1),
+            st,
+        )
+        eb = ctx.emits()
+        for p in nodes:
+            eb.send(
+                p,
+                user_kind(_H_REQVOTE),
+                (term, ctx.node, st[LOGLEN], _lastterm(st)),
+                when=fire & (jnp.int32(p) != ctx.node),
+            )
+        _arm_election(ctx, eb, st[TSEQ] + 1, fire)
+        return new, eb.build()
+
+    def on_reqvote(ctx):
+        st = ctx.state
+        term, cand = ctx.args[0], ctx.args[1]
+        c_len, c_lt = ctx.args[2], ctx.args[3]
+        newer = term > st[TERM]
+        st1 = jnp.where(
+            newer,
+            st.at[TERM].set(term).at[ROLE].set(FOLLOWER).at[VOTES].set(0),
+            st,
+        )
+        # the up-to-date rule: candidate's (last term, length) >= ours
+        my_lt = _lastterm(st1)
+        up_to_date = (c_lt > my_lt) | ((c_lt == my_lt) & (c_len >= st1[LOGLEN]))
+        grant = (term == st1[TERM]) & (st1[VOTED] < term) & up_to_date
+        new = jnp.where(
+            grant, st1.at[VOTED].set(term).at[TSEQ].set(st1[TSEQ] + 1), st1
+        )
+        eb = ctx.emits()
+        eb.send(cand, user_kind(_H_GRANT), (term,), when=grant)
+        _arm_election(ctx, eb, st1[TSEQ] + 1, grant)
+        return new, eb.build()
+
+    def on_grant(ctx):
+        st = ctx.state
+        term = ctx.args[0]
+        counts = (st[ROLE] == jnp.int32(CANDIDATE)) & (term == st[TERM])
+        votes = jnp.where(counts, st[VOTES] + 1, st[VOTES])
+        wins = counts & (votes >= jnp.int32(majority))
+        new = st.at[VOTES].set(votes)
+        new = jnp.where(wins, new.at[ROLE].set(LEADER), new)
+        # win-time re-stamp: uncommitted suffix takes the new term (the
+        # figure-8 guard, see module docstring)
+        for j in range(w):
+            stamped = (new[LOG0 + j] & jnp.int32(0xFF)) | (term << jnp.int32(8))
+            restamp = wins & (jnp.int32(j) >= new[COMMIT]) & (
+                jnp.int32(j) < new[LOGLEN]
+            )
+            new = jnp.where(restamp, new.at[LOG0 + j].set(stamped), new)
+        has_inflight = new[LOGLEN] > new[COMMIT]
+        new = jnp.where(
+            wins,
+            new.at[ACKS].set(
+                jnp.where(has_inflight, jnp.int32(1) << ctx.node, 0)
+            ),
+            new,
+        )
+        eb = ctx.emits()
+        _send_appends(ctx, eb, new, term, wins)
+        eb.after(propose_ns, user_kind(_H_PROPOSE), ctx.node, (term,), when=wins)
+        eb.after(retx_ns, user_kind(_H_RETX), ctx.node, (term,), when=wins)
+        return new, eb.build()
+
+    def on_append(ctx):
+        st = ctx.state
+        term, idx, l_commit = ctx.args[0], ctx.args[1], ctx.args[2]
+        leader = ctx.args[3]
+        ok = term >= st[TERM]
+        newer_term = term > st[TERM]
+        new = jnp.where(
+            ok,
+            st.at[TERM].set(term).at[ROLE].set(FOLLOWER)
+            .at[TSEQ].set(st[TSEQ] + 1),
+            st,
+        )
+        # adopt the leader's full log prefix (single-inflight install).
+        # Within a term there is one leader and its log only grows, so a
+        # same-term append may only EXTEND — a stale retransmission with
+        # a lower idx must not regress a log we already acked at a
+        # higher idx. A higher term overwrites unconditionally (the new
+        # leader's log is authoritative).
+        adopt = ok & (idx >= 0) & (newer_term | (idx + 1 >= st[LOGLEN]))
+        for j in range(w):
+            take = adopt & (jnp.int32(j) <= idx)
+            new = jnp.where(take, new.at[LOG0 + j].set(ctx.payload[j]), new)
+        new = jnp.where(adopt, new.at[LOGLEN].set(idx + 1), new)
+        new = jnp.where(
+            ok, new.at[COMMIT].set(jnp.maximum(new[COMMIT], l_commit)), new
+        )
+        eb = ctx.emits()
+        eb.send(
+            leader, user_kind(_H_ACKAPP), (term, idx, ctx.node), when=adopt
+        )
+        # a heartbeat resets the election timer
+        _arm_election(ctx, eb, st[TSEQ] + 1, ok)
+        return new, eb.build()
+
+    def on_ackapp(ctx):
+        st = ctx.state
+        term, idx, frm = ctx.args[0], ctx.args[1], ctx.args[2]
+        counts = (
+            (st[ROLE] == jnp.int32(LEADER))
+            & (term == st[TERM])
+            & (idx == st[LOGLEN] - 1)
+            & (st[COMMIT] < st[LOGLEN])
+        )
+        acks = jnp.where(counts, st[ACKS] | (jnp.int32(1) << frm), st[ACKS])
+        n_acks = jnp.int32(0)
+        for p in nodes:
+            n_acks = n_acks + ((acks >> jnp.int32(p)) & jnp.int32(1))
+        commit_now = counts & (n_acks >= jnp.int32(majority))
+        new = st.at[ACKS].set(acks)
+        new = jnp.where(commit_now, new.at[COMMIT].set(idx + 1), new)
+        eb = ctx.emits()
+        # propagate the commit index immediately
+        _send_appends(ctx, eb, new, term, commit_now)
+        eb.halt(when=commit_now & (new[COMMIT] == jnp.int32(w)))
+        return new, eb.build()
+
+    def on_propose(ctx):
+        st = ctx.state
+        term = ctx.args[0]
+        alive_leader = (st[ROLE] == jnp.int32(LEADER)) & (term == st[TERM])
+        can = alive_leader & (st[COMMIT] == st[LOGLEN]) & (
+            st[LOGLEN] < jnp.int32(w)
+        )
+        value = (ctx.draw.user(_P_VALUE) & jnp.uint32(0xFF)).astype(jnp.int32)
+        entry = value | (st[TERM] << jnp.int32(8))
+        new = st
+        for j in range(w):
+            new = jnp.where(
+                can & (st[LOGLEN] == j), new.at[LOG0 + j].set(entry), new
+            )
+        new = jnp.where(
+            can,
+            new.at[LOGLEN].set(st[LOGLEN] + 1)
+            .at[ACKS].set(jnp.int32(1) << ctx.node),
+            new,
+        )
+        eb = ctx.emits()
+        _send_appends(ctx, eb, new, term, can)
+        eb.after(
+            propose_ns, user_kind(_H_PROPOSE), ctx.node, (term,),
+            when=alive_leader,
+        )
+        return new, eb.build()
+
+    def on_retx(ctx):
+        st = ctx.state
+        term = ctx.args[0]
+        alive_leader = (st[ROLE] == jnp.int32(LEADER)) & (term == st[TERM])
+        # re-replicate whatever is outstanding; doubles as the heartbeat
+        send = alive_leader & (st[LOGLEN] > 0)
+        eb = ctx.emits()
+        _send_appends(ctx, eb, st, term, send)
+        eb.after(
+            retx_ns, user_kind(_H_RETX), ctx.node, (term,), when=alive_leader
+        )
+        return ctx.state, eb.build()
+
+    return Workload(
+        name="raftlog",
+        n_nodes=n_nodes,
+        state_width=width,
+        handlers=(
+            on_init, on_timeout, on_reqvote, on_grant, on_append,
+            on_ackapp, on_propose, on_retx,
+        ),
+        # widest: on_grant = N gated append rows + propose + retx timers
+        max_emits=n_nodes + 2,
+        payload_words=w,
+        args_words=4,
+        # largest timer: election timeout, leader timers, or the chaos
+        # restart at 'at + revive' <= 500 + 600 ms
+        delay_bound_ns=max(
+            timeout_max_ns, propose_ns, retx_ns, 1_100_000_000
+        ),
+    )

@@ -24,6 +24,7 @@ from madsim_tpu.models import (
     make_microbench,
     make_pingpong,
     make_raft,
+    make_raftlog,
     make_twophase,
 )
 
@@ -156,3 +157,18 @@ def test_twophase_no_chaos_bit_identical():
     wl = make_twophase(txns=3, chaos=False)
     cfg = EngineConfig(pool_size=64, loss_p=0.05)
     compare(wl, cfg, list(range(8)), 400, txns=3, chaos=False)
+
+
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_raftlog_traces_bit_identical(layout):
+    # raft log replication + leader crash — the seventh oracle-verified
+    # protocol family (payload arena carries the full log in appends)
+    wl = make_raftlog()
+    cfg = EngineConfig(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    compare(wl, cfg, list(range(12)), 3000, layout=layout)
+
+
+def test_raftlog_no_chaos_bit_identical():
+    wl = make_raftlog(chaos=False, n_writes=3)
+    cfg = EngineConfig(pool_size=64, loss_p=0.05)
+    compare(wl, cfg, list(range(8)), 2000, chaos=False, n_writes=3)

@@ -62,7 +62,10 @@ _P_REVIVE = 4
 
 
 def _entry_term(e):
-    return (e >> jnp.int32(8)) & jnp.int32(0xFF)
+    # value = low 8 bits, term = the remaining 23 — terms are unbounded
+    # in long chaos runs (an 0xFF mask here would silently wrap term 256
+    # to 0 and corrupt the up-to-date vote rule)
+    return e >> jnp.int32(8)
 
 
 def make_raftlog(

@@ -1044,7 +1044,9 @@ void raftlog_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
                 P_REVIVE = 4;
   const int32_t N = g_rl.n_nodes, W = g_rl.n_writes;
   const int32_t majority = N / 2 + 1;
-  auto entry_term = [](int32_t e) { return (e >> 8) & 0xFF; };
+  // value = low 8 bits, term = the remaining 23 (unbounded terms; a
+  // 0xFF mask would wrap term 256 to 0 and corrupt the vote rule)
+  auto entry_term = [](int32_t e) { return e >> 8; };
   auto lastterm = [&](const int32_t* st) {
     int32_t acc = 0;
     for (int32_t j = 0; j < W; j++)
@@ -1121,12 +1123,7 @@ void raftlog_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
         ns[TSEQ] = st1[TSEQ] + 1;
       }
       eff->emits.push_back(mk_send(cand, K_GRANT, term, 0, grant));
-      {
-        int64_t d =
-            ctx.draw.user_int(g_rl.timeout_min, g_rl.timeout_max, P_TIMEOUT);
-        eff->emits.push_back(
-            mk_after(d, K_TIMEOUT, ctx.node, st1[TSEQ] + 1, grant));
-      }
+      arm(st1[TSEQ] + 1, grant);
       break;
     }
     case 3: {  // on_grant

@@ -97,3 +97,21 @@ def test_search_reuses_compiled_run():
     search_seeds(wl, cfg, inv, n_seeds=32, max_steps=200)
     search_seeds(wl, cfg, inv, n_seeds=32, max_steps=200)
     assert len(search._RUN_CACHE) == before + 1
+
+
+def test_compact_search_same_verdicts_and_traces():
+    # compact=True runs the seed-compaction path: identical per-seed
+    # verdicts and trace hashes, narrower view (node_state etc. only)
+    wl = make_kvchaos(writes=5)
+    cfg = EngineConfig(pool_size=48, loss_p=0.02)
+
+    def all_replicas_current(v):
+        return (np.asarray(v["node_state"])[:, 1:5, 1] >= 5).all(axis=1)
+
+    full = search_seeds(wl, cfg, all_replicas_current, n_seeds=256, max_steps=900)
+    fast = search_seeds(
+        wl, cfg, all_replicas_current, n_seeds=256, max_steps=900, compact=True
+    )
+    assert np.array_equal(full.failing_seeds, fast.failing_seeds)
+    assert np.array_equal(full.traces, fast.traces)
+    assert np.array_equal(full.halted, fast.halted)

@@ -1,7 +1,8 @@
 """Headline benchmark: simulated-seconds/sec/chip across the BASELINE configs.
 
-Reports all five BASELINE.md benchmark configs and prints the headline
-JSON line (raft, the north-star workload) LAST:
+Reports all five BASELINE.md benchmark configs plus raftlog (the raft
+log-replication family, beyond-BASELINE) and prints the headline JSON
+line (raft, the north-star workload) LAST:
 
     {"metric": "sim_seconds_per_sec_per_chip", "value": N,
      "unit": "sim_s/s/chip", "vs_baseline": N / 200000,
@@ -31,10 +32,10 @@ TARGET = 200_000.0  # BASELINE.json north star, sim_s/s
 # name -> (n_seeds, max_steps, pool_size). Steps are run_while caps; the
 # runner exits as soon as every seed halts. CPU-fallback seed counts are
 # capped so a wedged-tunnel round still finishes within budget.
-# The workload factories, engine configs (pool sizes: every workload's
-# peak in-flight event count measured < 32 with zero overflow and traces
-# identical to pool 128; 48 leaves headroom while keeping the (S, E)
-# state arrays small), seed counts and step caps live in
+# The workload factories, engine configs (pool sizes sized to measured
+# peak in-flight event counts with zero overflow: < 32 for the five
+# BASELINE workloads, 48 with headroom; raftlog's append fan-out peaks
+# higher, 64), seed counts and step caps live in
 # madsim_tpu.models.BENCH_SPECS, shared with the cross-backend
 # determinism artifact (examples/cross_backend_check.py). This mirror
 # keeps the parent process jax-free (the resilience contract above):
@@ -45,6 +46,7 @@ CONFIGS = {
     "pingpong": (1, 300),
     "broadcast": (16384, 500),
     "kvchaos": (4096, 900),
+    "raftlog": (16384, 4000),
 }
 # BASELINE.md config 1 specifies the single-seed pingpong on the CPU sim
 # runtime — a lone seed cannot amortize accelerator dispatch overhead

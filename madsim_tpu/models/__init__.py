@@ -43,4 +43,7 @@ BENCH_SPECS = {
     "pingpong": (make_pingpong, dict(pool_size=32, **_B2), 1, 300),
     "broadcast": (make_broadcast, dict(pool_size=48, loss_p=0.05, **_B2), 16384, 500),
     "kvchaos": (make_kvchaos, dict(pool_size=48, loss_p=0.02, **_B2), 4096, 900),
+    # beyond the 5 BASELINE configs: the raft log-replication family
+    # (protocol depth on the north-star workload; reported, non-headline)
+    "raftlog": (make_raftlog, dict(pool_size=64, loss_p=0.02, **_B2), 16384, 4000),
 }

@@ -57,7 +57,9 @@ def child() -> None:
     seeds = np.arange(n_seeds, dtype=np.uint64)
     out = {"platform": jax.devices()[0].platform, "configs": {}}
     # the SAME configurations the benchmark reports (shared table), so
-    # this artifact certifies exactly what bench.py measures; step caps
+    # a freshly generated artifact certifies exactly what bench.py
+    # measures (regenerate after any BENCH_SPECS change — the committed
+    # JSON records the spec table at its generation time); step caps
     # trimmed where the workload halts far earlier
     # (raftlog's 4000 in BENCH_SPECS is a run_while chaos-tail cap; its
     # seeds halt well under 400 lockstep steps — tests/test_engine.py)

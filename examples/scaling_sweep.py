@@ -1,8 +1,9 @@
 """Batch-size scaling sweep: sim-s/s across seeds x the five configs.
 
-Produces the SCALING.md evidence: for each benchmark config, run the
-bench measurement at seed counts 1k/4k/16k/65k (256k extra for raft; a
-single-seed cell extra for pingpong, BASELINE config 1) and record
+Produces the SCALING.md evidence: for each of the six benchmark
+configs (the five BASELINE ones + raftlog), run the bench measurement
+at seed counts 1k/4k/16k/65k (256k extra for raft; a single-seed cell
+extra for pingpong, BASELINE config 1) and record
 simulated-seconds/sec plus wall per step. Uses the same compacted
 runner and compute/assemble timing seam as bench.py; it differs from
 the headline artifact in repeat policy (best-of-3 every cell, vs

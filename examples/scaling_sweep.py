@@ -1,4 +1,4 @@
-"""Batch-size scaling sweep: sim-s/s across seeds x the five configs.
+"""Batch-size scaling sweep: sim-s/s across seeds x the six configs.
 
 Produces the SCALING.md evidence: for each of the six benchmark
 configs (the five BASELINE ones + raftlog), run the bench measurement

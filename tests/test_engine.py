@@ -663,3 +663,30 @@ class TestRaftLog:
         )
         assert time32_eligible(wl, cfg)
         check_layouts(wl, cfg, np.arange(8), 500)
+
+
+def test_config_fuzz_layouts_agree():
+    """Randomized configs — including overflow-inducing tiny pools,
+    total packet loss, degenerate latency ranges and mid-run time
+    limits — must keep every lowering combination (dense/scatter x
+    int64/int32 when eligible) bit-identical. The drop rule under pool
+    overflow is deterministic (rank-based), so even lossy runs agree."""
+    from madsim_tpu.engine import EngineConfig, check_layouts
+    from madsim_tpu.models import make_broadcast, make_raft
+
+    rng = np.random.RandomState(20260730)
+    for case in range(6):
+        lat_min = int(rng.randint(1, 5_000_000))
+        span = int(rng.randint(0, 10_000_000))
+        cfg = EngineConfig(
+            pool_size=int(rng.choice([8, 12, 40, 64])),
+            lat_min_ns=lat_min,
+            lat_max_ns=lat_min + span,
+            loss_p=float(rng.choice([0.0, 0.05, 0.5, 1.0])),
+            proc_min_ns=50,
+            proc_max_ns=int(rng.choice([50, 100, 1000])),
+            clog_backoff_max_ns=2_000_000_000,
+            time_limit_ns=int(rng.choice([0, 200_000_000])),
+        )
+        wl = make_raft() if case % 2 == 0 else make_broadcast()
+        check_layouts(wl, cfg, np.arange(6, dtype=np.uint64), 120)

@@ -33,9 +33,9 @@ TARGET = 200_000.0  # BASELINE.json north star, sim_s/s
 # runner exits as soon as every seed halts. CPU-fallback seed counts are
 # capped so a wedged-tunnel round still finishes within budget.
 # The workload factories, engine configs (pool sizes sized to measured
-# peak in-flight event counts with zero overflow — raft 40,
-# microbench/pingpong 32, broadcast/kvchaos 48, raftlog 64), seed
-# counts and step caps live in
+# peak in-flight event counts with zero overflow — raft/broadcast/
+# kvchaos 40, microbench/pingpong 32, raftlog 64), seed counts and
+# step caps live in
 # madsim_tpu.models.BENCH_SPECS, shared with the cross-backend
 # determinism artifact (examples/cross_backend_check.py). This mirror
 # keeps the parent process jax-free (the resilience contract above):

@@ -16,6 +16,18 @@ from __future__ import annotations
 import sys
 import time
 
+import os
+
+import jax
+
+if os.environ.get("MADSIM_DEMO_PLATFORM", "cpu") == "cpu":
+    # demos default to CPU: the image's accelerator tunnel can wedge
+    # such that ANY axon backend init hangs forever (not fails), and
+    # env vars cannot pin the platform here (sitecustomize sets it via
+    # jax config at interpreter start). Set MADSIM_DEMO_PLATFORM=default
+    # to run on the accelerator when the tunnel is known-good.
+    jax.config.update("jax_platforms", "cpu")
+
 from madsim_tpu.engine import EngineConfig, search_seeds
 from madsim_tpu.models import make_kvchaos
 
@@ -34,9 +46,12 @@ def main() -> None:
         return (replicas >= writes).all(axis=1)
 
     t0 = time.perf_counter()
+    # compact=True: the seed-compaction path (identical verdicts and
+    # traces; the invariant only reads node_state, well within the
+    # banked view)
     report = search_seeds(
         wl, cfg, every_replica_fully_applied,
-        n_seeds=n_seeds, max_steps=900,
+        n_seeds=n_seeds, max_steps=900, compact=True,
     )
     wall = time.perf_counter() - t0
     print(report.banner(limit=5))

@@ -12,16 +12,19 @@ Prints one JSON object per measurement plus a summary line.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
-
-if len(sys.argv) > 2 and sys.argv[2] == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 
 import jax
+
+if len(sys.argv) > 2 and sys.argv[2] == "cpu":
+    # env vars cannot pin the platform here: the image's sitecustomize
+    # registers the axon plugin and sets the platform via jax config at
+    # interpreter start, so only a config update wins (and with a
+    # wedged tunnel, any axon init would hang forever)
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from jax import lax
 

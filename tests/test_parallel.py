@@ -123,6 +123,20 @@ def test_make_mesh_shapes():
     assert mesh2.shape["chip"] == jax.device_count() // 2
 
 
+def assert_compacted_equal(ref, out):
+    """Per-seed equality on every banked result field except 'step'
+    (documented divergence, engine/compact.py)."""
+    from madsim_tpu.engine.compact import RESULT_FIELDS
+
+    for f in RESULT_FIELDS:
+        if f == "step":
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f)), np.asarray(getattr(out, f)),
+            err_msg=f,
+        )
+
+
 @pytest.mark.parametrize("name", ["raft", "kvchaos"])
 def test_shard_run_compacted_equals_unsharded(name):
     # per-device local compaction: phase boundaries fall at different
@@ -130,7 +144,6 @@ def test_shard_run_compacted_equals_unsharded(name):
     # per-seed results must be bit-identical to both the unsharded
     # compactor and the lockstep loop
     from madsim_tpu.engine import make_run_compacted
-    from madsim_tpu.engine.compact import RESULT_FIELDS
     from madsim_tpu.models import BENCH_SPECS
     from madsim_tpu.parallel import shard_run_compacted
 
@@ -144,15 +157,8 @@ def test_shard_run_compacted_equals_unsharded(name):
     sharded = shard_run_compacted(
         wl, cfg, 2000, mesh, shrink=2, min_size=4
     )(shard_state(init(seeds), mesh))
-    for f in RESULT_FIELDS:
-        if f == "step":
-            continue  # documented divergence (engine/compact.py)
-        np.testing.assert_array_equal(
-            np.asarray(getattr(ref, f)), getattr(sharded, f), err_msg=f
-        )
-        np.testing.assert_array_equal(
-            getattr(solo, f), getattr(sharded, f), err_msg=f
-        )
+    assert_compacted_equal(ref, sharded)
+    assert_compacted_equal(solo, sharded)
 
 
 def test_shard_run_compacted_rejects_uneven_split():
@@ -166,3 +172,28 @@ def test_shard_run_compacted_rejects_uneven_split():
     state = make_init(wl, cfg)(np.arange(12, dtype=np.uint64))
     with pytest.raises(ValueError, match="do not split"):
         run(state)
+
+
+def test_shard_run_compacted_at_step_cap():
+    # a cap where SOME seeds have halted and some are live: shards hit
+    # different compaction points (banked rows diverge per shard) and
+    # the live rows must freeze identically to the lockstep loop
+    from madsim_tpu.models import BENCH_SPECS
+    from madsim_tpu.parallel import shard_run_compacted
+
+    factory, kw, _, _ = BENCH_SPECS["raft"]
+    wl, cfg = factory(), EngineConfig(**kw)
+    seeds = np.arange(64, dtype=np.uint64)
+    init = make_init(wl, cfg)
+    cap = 18  # raft seeds halt from ~step 12; the tail runs past 25
+    ref = jax.block_until_ready(
+        jax.jit(make_run_while(wl, cfg, cap))(init(seeds))
+    )
+    halted = np.asarray(ref.halted)
+    assert halted.any(), "cap must land after the first halts"
+    assert not halted.all(), "cap must hit while rows are still live"
+    mesh = make_mesh(jax.devices())
+    out = shard_run_compacted(wl, cfg, cap, mesh, shrink=2, min_size=2)(
+        shard_state(init(seeds), mesh)
+    )
+    assert_compacted_equal(ref, out)

@@ -306,6 +306,8 @@ class Queue:
         self._items: list = []
         self._getters: list[SimFuture] = []
         self._putters: list[tuple[SimFuture, Any]] = []
+        self._unfinished_tasks = 0
+        self._join_waiters: list[SimFuture] = []
 
     # -- sim implementation --
     def qsize(self) -> int:
@@ -334,6 +336,7 @@ class Queue:
         if self.full():
             raise QueueFull
         self._push_item(item)
+        self._unfinished_tasks += 1
         while self._getters:
             g = self._getters.pop(0)
             if not g.done():
@@ -358,11 +361,29 @@ class Queue:
                 break
         return item
 
-    async def join(self) -> None:  # simplified: no task tracking
-        return None
+    async def join(self) -> None:
+        """Block until every item ever put has been marked task_done.
+
+        The real asyncio contract (unfinished-task count, not queue
+        emptiness): the reference's tokio shim gets this for free by
+        reusing real tokio sync types (madsim-tokio/src/lib.rs:39-52 —
+        "tokio::sync is designed for single thread"); the sim Queue
+        implements the same counter semantics directly.
+        """
+        while self._unfinished_tasks > 0:
+            fut = SimFuture(name="queue.join")
+            self._join_waiters.append(fut)
+            await fut
 
     def task_done(self) -> None:
-        return None
+        if self._unfinished_tasks <= 0:
+            raise ValueError("task_done() called too many times")
+        self._unfinished_tasks -= 1
+        if self._unfinished_tasks == 0:
+            waiters, self._join_waiters = self._join_waiters, []
+            for w in waiters:
+                if not w.done():
+                    w.set_result(None)
 
 
 class LifoQueue(Queue):

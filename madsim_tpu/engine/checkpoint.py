@@ -39,7 +39,17 @@ def save(path: str, state: SimState, cfg: EngineConfig) -> None:
         f.name: np.asarray(getattr(state, f.name))
         for f in dataclasses.fields(state)
     }
-    manifest = json.dumps({"format": _FORMAT, "config_hash": cfg.hash()})
+    # ev_time dtype records the time representation (int32 = time32
+    # offset form, int64 = absolute): time32 auto-resolution depends on
+    # the config *and* the builder arguments, so the config hash alone
+    # can't catch a checkpoint resumed under the other representation
+    manifest = json.dumps(
+        {
+            "format": _FORMAT,
+            "config_hash": cfg.hash(),
+            "ev_time_dtype": str(np.asarray(state.ev_time).dtype),
+        }
+    )
     arrays[_MANIFEST_KEY] = np.frombuffer(manifest.encode(), dtype=np.uint8)
     # write through a file handle so the given path is used verbatim
     # (np.savez(path_str) would append .npz and break load symmetry)
@@ -47,8 +57,17 @@ def save(path: str, state: SimState, cfg: EngineConfig) -> None:
         np.savez(fh, **arrays)
 
 
-def load(path: str, cfg: EngineConfig) -> SimState:
-    """Load a SimState; refuses a checkpoint taken under another config."""
+def load(path: str, cfg: EngineConfig, time32: bool | None = None) -> SimState:
+    """Load a SimState; refuses a checkpoint taken under another config.
+
+    ``time32``: the representation the resumed run will use (what you
+    will pass to make_run/make_run_while/make_run_compacted). time32
+    auto-resolution is platform-dependent (int32 on accelerators when
+    eligible, int64 on CPU), so a checkpoint saved on one platform can
+    silently mismatch the builder on another; passing it here turns the
+    later step-time dtype TypeError into an immediate, explained error.
+    None skips the check (the manifest still records the saved dtype).
+    """
     with np.load(path) as data:
         manifest = json.loads(bytes(data[_MANIFEST_KEY]).decode())
         if manifest.get("format") != _FORMAT:
@@ -62,4 +81,17 @@ def load(path: str, cfg: EngineConfig) -> SimState:
         fields = {
             f.name: jnp.asarray(data[f.name]) for f in dataclasses.fields(SimState)
         }
-    return SimState(**fields)
+    state = SimState(**fields)
+    saved_dt = manifest.get("ev_time_dtype", str(np.asarray(state.ev_time).dtype))
+    if time32 is not None:
+        want_dt = "int32" if time32 else "int64"
+        if saved_dt != want_dt:
+            raise ValueError(
+                f"checkpoint ev_time dtype is {saved_dt} but the resumed run "
+                f"was declared time32={time32} ({want_dt}); pass the matching "
+                "explicit time32= to make_run/make_run_while/"
+                "make_run_compacted (auto-resolution is platform-dependent, "
+                "so a checkpoint saved on another platform will not resume "
+                "under the default)"
+            )
+    return state

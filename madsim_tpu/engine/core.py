@@ -1098,6 +1098,13 @@ def make_run(
     array's leading axis is the seed axis, so a NamedSharding over that
     axis turns this into pure data-parallel work across chips with zero
     collectives in the hot loop (results are combined host-side).
+
+    time32 contract: under the int32 time representation a timer delay
+    past the int32 horizon (``cfg.delay_bound_ns`` eligibility) is
+    clamped and counted in ``state.overflow`` — the run continues on a
+    trajectory that may diverge from the int64 layout. Callers must
+    check ``overflow == 0`` before trusting per-seed results (bench.py
+    and engine.search do; direct callers are responsible themselves).
     """
     step = jax.vmap(make_step(wl, cfg, layout, time32))
 
@@ -1126,6 +1133,11 @@ def make_run_while(
     round would otherwise cost every seed the full max_steps). Note the
     all-halted reduction runs per iteration; with a sharded seed axis it
     is XLA's only collective in the loop (a cheap scalar all-reduce).
+
+    The :func:`make_run` time32 contract applies here too: horizon-
+    clamped timer delays are counted in ``state.overflow`` and the run
+    silently continues — check ``overflow == 0`` before trusting
+    per-seed results.
     """
     step = jax.vmap(make_step(wl, cfg, layout, time32))
 

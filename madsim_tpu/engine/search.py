@@ -70,7 +70,11 @@ class SearchReport:
     halted: np.ndarray  # (S,) bool
     overflowed: np.ndarray  # (S,) bool — event-pool drops: verdict unreliable
     traces: np.ndarray  # (S,) uint64 — per-seed trace hashes
-    steps: int  # engine steps the sweep ran
+    # max per-seed step coordinate. Under compact=True the per-row step
+    # counters freeze when a row is banked out, so this equals the
+    # lockstep loop's iteration count only for the last-halting seed
+    # (per-seed values are still bit-identical between the two paths).
+    steps: int
 
     @property
     def failing_seeds(self) -> np.ndarray:

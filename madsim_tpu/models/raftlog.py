@@ -4,8 +4,10 @@ Extends the election-only north-star workload (models/raft.py) to the
 full replication loop the reference ecosystem's flagship DST target
 (MadRaft) exercises: an elected leader proposes ``n_writes`` entries
 one at a time, replicates them with AppendEntries, commits each on a
-majority of acks, and the seed optionally schedules a node kill (often
-the leader) plus a later restart mid-stream. The instance halts when
+majority of acks, and (under ``chaos=True``) every seed schedules one
+node kill at a uniformly drawn node — ``user_int(0, n_nodes)`` is
+half-open, so some valid node is always hit — plus a later restart
+mid-stream. The instance halts when
 the final entry commits; the test-checkable safety invariant is the
 raft one: **every committed entry is present, in order and with equal
 values, on a majority of nodes at halt** — across elections, crashes,

@@ -101,6 +101,44 @@ def test_queue_producer_consumer():
     assert run(5, main)
 
 
+def test_queue_join_task_done_contract():
+    """The real asyncio contract: join() blocks on the unfinished-task
+    count (every put needs a matching task_done), not queue emptiness —
+    the semantics madsim-tokio keeps exact by reusing real tokio sync
+    (madsim-tokio/src/lib.rs:39-52)."""
+
+    async def main():
+        q = aio.Queue()
+        done = []
+
+        async def producer():
+            for i in range(8):
+                await q.put(i)
+
+        async def consumer():
+            while True:
+                item = await q.get()
+                await aio.sleep(0.01)  # work happens after get()
+                done.append(item)
+                q.task_done()
+
+        await producer()  # canonical pattern: fill, then join
+        workers = [aio.create_task(consumer()) for _ in range(3)]
+        await q.join()  # must wait for the post-get work, not just drain
+        assert sorted(done) == list(range(8))
+        assert q.empty()
+        for w in workers:
+            w.cancel()
+        # join returns immediately once the count is zero
+        await q.join()
+        # task_done beyond the put count is an error
+        with pytest.raises(ValueError):
+            q.task_done()
+        return True
+
+    assert run(7, main)
+
+
 def test_priority_and_lifo_queue():
     async def main():
         pq = aio.PriorityQueue()

@@ -372,6 +372,23 @@ class TestRunWhileAndCheckpoint:
         with pytest.raises(ValueError, match="different EngineConfig"):
             load_checkpoint(path, EngineConfig(pool_size=8, loss_p=0.5))
 
+    def test_checkpoint_rejects_time_representation_mismatch(self, tmp_path):
+        """A checkpoint saved under one ev_time representation refuses a
+        declared resume under the other (auto-resolution is platform-
+        dependent, so this is the cross-platform resume hazard)."""
+        from madsim_tpu.engine import load_checkpoint, save_checkpoint
+
+        wl = make_microbench(rounds=5)
+        cfg = EngineConfig(pool_size=8)
+        st = make_init(wl, cfg)(np.arange(2, dtype=np.uint64))
+        path = str(tmp_path / "c.npz")
+        save_checkpoint(path, st, cfg)
+        saved32 = np.asarray(st.ev_time).dtype == np.int32
+        # matching declaration loads fine; the opposite one is rejected
+        load_checkpoint(path, cfg, time32=saved32)
+        with pytest.raises(ValueError, match="ev_time dtype"):
+            load_checkpoint(path, cfg, time32=not saved32)
+
 
 class TestKvChaos:
     def test_kvchaos_durability_invariant_under_crash(self):

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the TPU tunnel every PROBE_INTERVAL seconds; the moment it
+# answers, immediately capture the round's TPU bench artifact (the
+# tunnel historically wedges again within ~15 min — see SCALING.md §0).
+# Usage: tools/tpu_watch.sh OUT.jsonl [probe_interval_s] [probe_timeout_s]
+set -u
+OUT="${1:?usage: tpu_watch.sh OUT.jsonl [interval] [timeout]}"
+INTERVAL="${2:-600}"
+PROBE_TIMEOUT="${3:-60}"
+cd "$(dirname "$0")/.."
+while true; do
+  echo "$(date -u +%H:%M:%S) probing tpu..." >&2
+  if BENCH_CHILD=probe BENCH_PLATFORM=default timeout "$PROBE_TIMEOUT" \
+     python bench.py 2>/dev/null | grep -q '"ok": true'; then
+    echo "$(date -u +%H:%M:%S) TPU UP — running bench.py" >&2
+    BENCH_BUDGET=2400 python bench.py > "$OUT" 2>> /tmp/bench_watch.err
+    echo "$(date -u +%H:%M:%S) bench done -> $OUT" >&2
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

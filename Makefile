@@ -16,7 +16,7 @@
 PY      ?= python
 TESTENV ?= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check native test determinism bench-smoke clean
+.PHONY: check native test determinism bench-smoke bench-tpu-snapshot clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -35,6 +35,15 @@ bench-smoke: native
 	BENCH_CHILD=pingpong BENCH_PLATFORM=cpu BENCH_SEEDS=4 BENCH_STEPS=100 \
 	    $(PY) bench.py
 	$(PY) examples/rpc_bench.py
+
+# Session-start TPU capture: the TPU tunnel historically wedges
+# mid-session, so grab the round's accelerator numbers FIRST (same
+# schema as the driver's end-of-round bench.py artifact). bench.py
+# itself also does a staged retry after its CPU pass.
+SNAPSHOT ?= BENCH_TPU_snapshot.jsonl
+bench-tpu-snapshot:
+	$(PY) bench.py > $(SNAPSHOT)
+	@tail -1 $(SNAPSHOT)
 
 clean:
 	$(MAKE) -C native clean

@@ -106,32 +106,73 @@ def parent() -> None:
     per_cfg_cap = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "600"))
     t_start = time.monotonic()
 
-    mode, platform = probe_platform(timeout=min(180.0, budget / 3))
+    mode, platform = probe_platform(timeout=min(120.0, budget / 4))
     print(f"# probe: mode={mode} platform={platform}", file=sys.stderr)
 
     results = {}
-    for config, (n_seeds, n_steps) in CONFIGS.items():
-        remaining = budget - (time.monotonic() - t_start)
-        if remaining < 60 and results:
-            print(f"# budget exhausted, skipping {config}", file=sys.stderr)
-            continue
-        timeout = max(90.0, min(per_cfg_cap, remaining))
-        cfg_mode = "cpu" if config in CPU_ONLY_CONFIGS else mode
-        res = _run_child(cfg_mode, config, n_seeds, n_steps, timeout)
-        if res is None and cfg_mode == "default":
-            # accelerator wedged mid-run: degrade this and later configs
-            mode = "cpu"
-            platform = "cpu"
+
+    def sweep(run_mode: str, configs, stop_on_degrade: bool = False) -> str:
+        """Run configs under run_mode; returns the (possibly degraded)
+        mode. Results overwrite earlier entries for the same config, so
+        a successful late TPU retry replaces the CPU fallback number.
+        ``stop_on_degrade``: bail out once the accelerator wedges (the
+        retry pass — re-running CPU fallbacks would duplicate pass-1
+        results for pure budget waste)."""
+        cur = run_mode
+        for config, (n_seeds, n_steps) in configs:
+            if stop_on_degrade and cur == "cpu":
+                print(f"# retry degraded, skipping {config}", file=sys.stderr)
+                continue
             remaining = budget - (time.monotonic() - t_start)
-            res = _run_child("cpu", config, n_seeds, n_steps, max(90.0, min(per_cfg_cap, remaining)))
-        if res is not None and res.get("error"):
-            # a config-level failure (e.g. pool overflow), not a wedge:
-            # surface it and move on without degrading the platform
-            print(json.dumps(res), flush=True)
-            print(f"# {config}: {res['error']}", file=sys.stderr)
-        elif res is not None:
-            results[config] = res
-            print(json.dumps(res), flush=True)
+            if remaining < 60 and results:
+                print(f"# budget exhausted, skipping {config}", file=sys.stderr)
+                continue
+            timeout = max(90.0, min(per_cfg_cap, remaining))
+            cfg_mode = "cpu" if config in CPU_ONLY_CONFIGS else cur
+            res = _run_child(cfg_mode, config, n_seeds, n_steps, timeout)
+            if res is None and cfg_mode == "default":
+                # accelerator wedged mid-run: degrade this + later configs
+                cur = "cpu"
+                remaining = budget - (time.monotonic() - t_start)
+                if config not in results:  # keep any prior (TPU) result
+                    res = _run_child(
+                        "cpu", config, n_seeds, n_steps,
+                        max(90.0, min(per_cfg_cap, remaining)),
+                    )
+            if res is not None and res.get("error"):
+                # a config-level failure (e.g. pool overflow), not a
+                # wedge: surface it, move on, don't degrade the platform
+                print(json.dumps(res), flush=True)
+                print(f"# {config}: {res['error']}", file=sys.stderr)
+            elif res is not None:
+                results[config] = res
+                print(json.dumps(res), flush=True)
+        return cur
+
+    mode = sweep(mode, CONFIGS.items())
+    platform = "cpu" if mode == "cpu" else platform
+
+    # Staged retry: the tunnel historically wedges transiently. If the
+    # accelerator was unavailable (at probe time or mid-sweep), re-probe
+    # after the CPU pass and re-measure the accelerator configs — fresh
+    # runs only, never a replay of stale numbers.
+    remaining = budget - (time.monotonic() - t_start)
+    if mode == "cpu" and remaining > 180:
+        retry_mode, retry_platform = probe_platform(timeout=min(120.0, remaining / 3))
+        print(
+            f"# staged retry probe: mode={retry_mode} platform={retry_platform}",
+            file=sys.stderr,
+        )
+        if retry_mode == "default":
+            accel_cfgs = [
+                (c, v)
+                for c, v in CONFIGS.items()
+                if c not in CPU_ONLY_CONFIGS
+                and results.get(c, {}).get("platform", "cpu") == "cpu"
+            ]
+            final_mode = sweep("default", accel_cfgs, stop_on_degrade=True)
+            if final_mode == "default":
+                platform = retry_platform
 
     head = results.get("raft")
     value = float(head["value"]) if head else 0.0
@@ -146,7 +187,11 @@ def parent() -> None:
                 "platform": head.get("platform", platform) if head else platform,
                 "n_seeds": n_seeds,
                 "configs": {
-                    k: {"value": v["value"], "n_seeds": v["n_seeds"]}
+                    k: {
+                        "value": v["value"],
+                        "n_seeds": v["n_seeds"],
+                        "platform": v.get("platform", platform),
+                    }
                     for k, v in results.items()
                 },
             }
@@ -224,30 +269,64 @@ def child(config: str) -> None:
             sized *= 2
         n_seeds = sized
 
+    n_chips = max(jax.device_count(), 1)
+    if jax.devices()[0].platform != "cpu":
+        # accelerator: the remote-tunnel dispatch path has multi-100ms
+        # jitter, so sub-second runs measure the transport, not the
+        # chip. measure_throughput (engine/measure.py) packs repeated
+        # independent seed-batches into ONE >=5s jitted dispatch and
+        # reports the median over 5 dispatches — jitter amortized
+        # structurally, spread reported honestly.
+        from madsim_tpu.engine.measure import measure_throughput
+
+        # seeds wrap inside the range each pool size was verified
+        # overflow-free for (models.BENCH_SPECS sizing note): raft over
+        # 0..524287, the rest over the sweep's 0..131071
+        seed_mod = 524288 if config == "raft" else 131072
+        rec = measure_throughput(
+            wl, cfg, n_steps, n_seeds, target_wall_s=5.0, n_measure=5,
+            seed_mod=seed_mod, min_size=min(2048, max(n_seeds // 4, 1)),
+        )
+        # the small pool sizes are only valid while nothing overflows; a
+        # silent drop would skew the metric. Reported as a distinct
+        # JSON error (exit 0) so the parent records a config failure
+        # instead of misreading rc!=0 as a wedge and degrading to CPU.
+        if rec["overflow"]:
+            print(
+                json.dumps(
+                    {"config": config, "error": "pool_overflow", "drops": rec["overflow"]}
+                )
+            )
+            return
+        print(
+            json.dumps(
+                {
+                    "config": config,
+                    "metric": "sim_seconds_per_sec_per_chip",
+                    "value": round(rec["sim_s_per_s_median"] / n_chips, 2),
+                    "unit": "sim_s/s/chip",
+                    "platform": jax.devices()[0].platform,
+                    "n_seeds": n_seeds,
+                    "repeats_per_dispatch": rec["repeats"],
+                    "dispatch_walls_s": rec["dispatch_walls_s"],
+                    "spread_pct": rec["spread_pct"],
+                    "all_halted": rec["all_halted"],
+                }
+            )
+        )
+        return
+
     state = init(np.arange(n_seeds, dtype=np.uint64))
     jax.block_until_ready(run.compute(state))  # warm-up compile
 
-    # best of 5 on the accelerator: the remote-TPU dispatch path has
-    # multi-100ms jitter that dominates these sub-second runs; max
-    # throughput is the honest hardware number (same seeds each repeat —
-    # identical work). CPU has no such jitter: one measured run.
-    repeats = 5 if jax.devices()[0].platform != "cpu" else 1
-    wall = float("inf")
-    best = None
-    for _ in range(repeats):
-        state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
-        t0 = time.perf_counter()
-        banked = jax.block_until_ready(run.compute(state))
-        wall_i = time.perf_counter() - t0
-        if wall_i < wall:
-            wall, best = wall_i, banked
-    out = run.assemble(best)
+    # CPU has no dispatch jitter: one measured run
+    state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
+    t0 = time.perf_counter()
+    banked = jax.block_until_ready(run.compute(state))
+    wall = time.perf_counter() - t0
+    out = run.assemble(banked)
 
     sim_seconds = float(np.asarray(out.now, dtype=np.float64).sum() / 1e9)
-    # the small pool sizes are only valid while nothing overflows; a
-    # silent drop would skew the metric. Reported as a distinct JSON
-    # error (exit 0) so the parent records a config failure instead of
-    # misreading rc!=0 as a wedged accelerator and degrading to CPU.
     overflow = int(np.asarray(out.overflow).sum())
     if overflow:
         print(
@@ -256,7 +335,6 @@ def child(config: str) -> None:
             )
         )
         return
-    n_chips = max(jax.device_count(), 1)
     value = sim_seconds / wall / n_chips
     print(
         json.dumps(

@@ -31,27 +31,62 @@ from jax import lax
 from madsim_tpu.engine import EngineConfig, make_init, make_step
 from madsim_tpu.engine.core import _INF_NS, _meta_kind, _meta_node
 from madsim_tpu.engine.rng import PURPOSE_LATENCY, PURPOSE_POLL_COST, Draw
-from madsim_tpu.models import make_raft
+from madsim_tpu.models import BENCH_SPECS
 
 N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
-N_STEPS = 100
+N_STEPS = 100  # calibration scan length; timed runs auto-size upward
 REPEATS = 3
+# every timed run is sized to at least this wall so remote-tunnel
+# dispatch jitter (multi-100 ms) can't dominate a cell (SCALING.md §4)
+TARGET_WALL_S = 5.0
 
 
-def timed(name, fn, state):
-    """Median wall time of REPEATS runs of jitted fn (scanned N_STEPS)."""
-    jfn = jax.jit(fn)
-    jax.block_until_ready(jfn(state))  # compile
+def timed(name, body, state):
+    """Median wall of REPEATS sized runs; each run is ONE dispatch of a
+    scan long enough to hit TARGET_WALL_S (per-variant calibration —
+    cheap variants get proportionally longer scans)."""
+    cal = jax.jit(scan_n(body, N_STEPS))
+    jax.block_until_ready(cal(state))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(cal(state))
+    cal_wall = time.perf_counter() - t0
+
+    steps = N_STEPS
+    while cal_wall * (steps / N_STEPS) < TARGET_WALL_S and steps < 2_000_000:
+        steps *= 2
+    jfn = cal if steps == N_STEPS else jax.jit(scan_n(body, steps))
+    # the warm-up of each sized program re-calibrates: a contaminated
+    # first calibration (host contention, cache effects) otherwise
+    # leaves the cell sub-second and jitter-dominated again. Each
+    # re-jitted program is compiled (untimed) before its timed probe —
+    # otherwise the compile wall would satisfy the target spuriously.
+    for _ in range(6):
+        jax.block_until_ready(jfn(state))  # compile / cache hit, untimed
+        t0 = time.perf_counter()
+        jax.block_until_ready(jfn(state))
+        warm = time.perf_counter() - t0
+        if warm >= TARGET_WALL_S * 0.6 or steps >= 2_000_000:
+            break
+        per_step = warm / steps
+        new_steps = steps
+        while per_step * new_steps < TARGET_WALL_S and new_steps < 2_000_000:
+            new_steps *= 2
+        steps = new_steps
+        jfn = jax.jit(scan_n(body, steps))
+    jax.block_until_ready(jfn(state))  # compile, untimed (loop may exit
+    # by exhaustion with a freshly re-jitted, never-executed program)
     times = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         jax.block_until_ready(jfn(state))
         times.append(time.perf_counter() - t0)
     wall = sorted(times)[len(times) // 2]
-    us_per_step = wall / N_STEPS * 1e6
+    us_per_step = wall / steps * 1e6
     rec = {
         "variant": name,
+        "scan_steps": steps,
         "wall_s": round(wall, 4),
+        "spread_pct": round(100 * (max(times) - min(times)) / wall, 1),
         "us_per_step": round(us_per_step, 2),
         "ns_per_seed_step": round(us_per_step * 1e3 / N_SEEDS, 3),
     }
@@ -59,20 +94,23 @@ def timed(name, fn, state):
     return rec
 
 
-def scan_n(body):
+def scan_n(body, length):
     def run(st):
         def f(s, _):
             return body(s), None
 
-        out, _ = lax.scan(f, st, None, length=N_STEPS)
+        out, _ = lax.scan(f, st, None, length=length)
         return out
 
     return run
 
 
 def main():
-    wl = make_raft()
-    cfg = EngineConfig(pool_size=48, loss_p=0.02)
+    # the exact raft bench config (models.BENCH_SPECS), so the ablation
+    # describes the same program bench.py times
+    mk, cfg_kw, _, _ = BENCH_SPECS["raft"]
+    wl = mk()
+    cfg = EngineConfig(**cfg_kw)
     k = wl.max_emits
     init = make_init(wl, cfg)
     state = init(np.arange(N_SEEDS, dtype=np.uint64))
@@ -85,17 +123,20 @@ def main():
 
     # 1. the real thing
     step = jax.vmap(make_step(wl, cfg))
-    results["full_step"] = timed("full_step", scan_n(step), state)
+    results["full_step"] = timed("full_step", step, state)
 
-    # 2. pop only: argmin over the masked int64 pool
+    # 2. pop only: argmin over the masked pool. The (now & 1) term makes
+    # the input loop-VARIANT — without it the whole argmin is constant
+    # across scan iterations and XLA hoists it, timing an empty loop.
     def pop_only(st):
-        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        wob = (st.now & 1).astype(st.ev_time.dtype)[:, None]
+        tmask = jnp.where(st.ev_valid, st.ev_time + wob, _INF_NS)
         i = jnp.argmin(tmask, axis=1)
         rows = jnp.arange(st.ev_time.shape[0])
-        now = jnp.maximum(st.now, st.ev_time[rows, i])
+        now = st.now + jnp.maximum(jnp.int64(1), st.ev_time[rows, i].astype(jnp.int64))
         return st.__class__(**{**st.__dict__, "now": now})
 
-    results["pop_argmin"] = timed("pop_argmin", scan_n(pop_only), state)
+    results["pop_argmin"] = timed("pop_argmin", pop_only, state)
 
     # 3. RNG draws: poll cost + K paired latency/loss blocks (bits2)
     def draws_only(st):
@@ -112,12 +153,14 @@ def main():
         return st.__class__(**{**st.__dict__, "now": st.now + extra,
                                "step": st.step + jnp.uint32(1)})
 
-    results["rng_draws"] = timed("rng_draws", scan_n(draws_only), state)
+    results["rng_draws"] = timed("rng_draws", draws_only, state)
 
-    # 4. gathers: the per-seed dynamic reads the dispatch needs
+    # 4. gathers: the per-seed dynamic reads the dispatch needs (same
+    # loop-variance wobble as pop_only — see the hoisting note there)
     def gathers_only(st):
         rows = jnp.arange(st.ev_time.shape[0])
-        tmask = jnp.where(st.ev_valid, st.ev_time, _INF_NS)
+        wob = (st.now & 1).astype(st.ev_time.dtype)[:, None]
+        tmask = jnp.where(st.ev_valid, st.ev_time + wob, _INF_NS)
         i = jnp.argmin(tmask, axis=1)
         meta = st.ev_meta[rows, i]
         kind = _meta_kind(meta)
@@ -129,7 +172,7 @@ def main():
         acc = (kind + dst + args.sum(-1) + nstate.sum(-1) + alive).astype(jnp.int64)
         return st.__class__(**{**st.__dict__, "now": st.now + acc})
 
-    results["pop_gathers"] = timed("pop_gathers", scan_n(gathers_only), state)
+    results["pop_gathers"] = timed("pop_gathers", gathers_only, state)
 
     # 5. scatters: the emit-insertion writes (K slots into the E pool)
     def scatters_only(st):
@@ -155,7 +198,7 @@ def main():
                                "ev_time": ev_time, "ev_meta": ev_meta,
                                "ev_args": ev_args})
 
-    results["emit_scatters"] = timed("emit_scatters", scan_n(scatters_only), state)
+    results["emit_scatters"] = timed("emit_scatters", scatters_only, state)
 
     # (switch cost is measured by subtraction: full - pop - rng - gathers
     # - place; the branch table is internal to make_step)
@@ -182,7 +225,7 @@ def main():
         return st.__class__(**{**st.__dict__, "ev_valid": ev_valid, "ev_time": ev_time})
 
     results["dense_place_2fields"] = timed(
-        "dense_place_2fields", scan_n(place_only), state
+        "dense_place_2fields", place_only, state
     )
 
     full = results["full_step"]["us_per_step"]

@@ -1,0 +1,201 @@
+"""Jitter-proof throughput measurement for the batched engine.
+
+The TPU sits behind a remote tunnel whose dispatch path adds
+multi-100 ms jitter; a sub-second measured cell (raft @65,536 seeds
+runs ~0.2 s) is therefore dominated by transport noise — round 3's
+sweep admitted ±2x spread on identical configs. The fix is structural,
+not statistical: make each *dispatch* long enough that the jitter is
+amortized to nothing, then take the median over a handful of
+dispatches.
+
+``make_repeat_program`` builds ONE jitted program that runs ``repeats``
+independent seed-batches back-to-back on device (a ``lax.fori_loop``
+whose body is the full compacted phase program on a fresh batch of
+seeds), returning only scalar reductions (total simulated ns, overflow
+count, halted count). One dispatch -> one jitter sample, regardless of
+how much simulation rides inside it.
+
+``measure_throughput`` calibrates the single-batch wall, picks
+``repeats`` so a dispatch lasts >= ``target_wall_s`` (default 5 s,
+vs <= ~0.3 s of observed jitter), and reports the median sim-s/s over
+``n_measure`` dispatches with min/max spread.
+
+``null_dispatch_stats`` times a trivial kernel the same way the sweep
+times real ones, quantifying the per-dispatch overhead floor once per
+artifact instead of letting it silently contaminate every cell.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .compact import make_run_compacted
+from .core import EngineConfig, Workload, make_init
+
+__all__ = ["make_repeat_program", "measure_throughput", "null_dispatch_stats"]
+
+
+def make_repeat_program(
+    wl: Workload,
+    cfg: EngineConfig,
+    max_steps: int,
+    n_seeds: int,
+    seed_mod: int,
+    layout: str | None = None,
+    time32: bool | None = None,
+    shrink: int = 4,
+    min_size: int = 2048,
+):
+    """Build ``program(seed_base, repeats) -> (sim_ns, overflow, halted)``.
+
+    Runs ``repeats`` batches of ``n_seeds`` seeds (values
+    ``(seed_base + r*n_seeds + i) % seed_mod``) through the compacted
+    phase program inside one jitted ``fori_loop`` and reduces each to
+    scalars: total simulated nanoseconds, total pool-overflow count,
+    total halted-row count (== repeats*n_seeds iff every seed halted).
+
+    ``repeats`` is a *runtime* argument (dynamic trip count), so one
+    compile serves both the calibration run and the sized run.
+    ``seed_mod`` keeps every seed inside the range the config's pool
+    size was verified overflow-free for (models.BENCH_SPECS sizing) —
+    without it, long measurement sessions would drift seeds millions
+    past the verified range; repeated seed values across repeats are
+    identical work, which is exactly what a throughput measure wants.
+    """
+    if seed_mod < n_seeds:
+        raise ValueError(f"seed_mod={seed_mod} must be >= n_seeds={n_seeds}")
+    init = make_init(wl, cfg, time32)
+    run = make_run_compacted(
+        wl, cfg, max_steps, layout, time32,
+        shrink=shrink, min_size=min_size, fields=("now", "overflow", "halted"),
+    )
+
+    def program(seed_base, repeats):
+        seed_base = jnp.asarray(seed_base, jnp.uint64)
+        lanes = jnp.arange(n_seeds, dtype=jnp.uint64)
+
+        def body(r, acc):
+            sim_ns, ovf, halted = acc
+            seeds = (
+                seed_base + jnp.uint64(r) * jnp.uint64(n_seeds) + lanes
+            ) % jnp.uint64(seed_mod)
+            banked = run.phases(init(seeds))
+            for b in banked:
+                sim_ns = sim_ns + jnp.sum(b["now"]).astype(jnp.int64)
+                ovf = ovf + jnp.sum(b["overflow"]).astype(jnp.int64)
+                halted = halted + jnp.sum(b["halted"]).astype(jnp.int64)
+            return (sim_ns, ovf, halted)
+
+        return lax.fori_loop(
+            0, repeats, body,
+            (jnp.int64(0), jnp.int64(0), jnp.int64(0)),
+        )
+
+    return jax.jit(program)
+
+
+def _round_up_pow2(x: int) -> int:
+    r = 1
+    while r < x:
+        r *= 2
+    return r
+
+
+def measure_throughput(
+    wl: Workload,
+    cfg: EngineConfig,
+    max_steps: int,
+    n_seeds: int,
+    target_wall_s: float = 5.0,
+    n_measure: int = 5,
+    seed_base: int = 0,
+    seed_mod: int = 131072,
+    max_repeats: int = 4096,
+    layout: str | None = None,
+    time32: bool | None = None,
+    shrink: int = 4,
+    min_size: int = 2048,
+) -> dict:
+    """Measure sim-s/s with >= ``target_wall_s``-long dispatches.
+
+    Returns a dict with the median rate over ``n_measure`` timed
+    dispatches plus the full per-dispatch walls, the repeat count, and
+    correctness counters (overflow must be 0 and halted must equal
+    seeds*repeats for the rate to be quotable — callers check).
+    ``seed_mod`` must cover only seeds the config's pool size is
+    verified overflow-free for (see make_repeat_program, which raises
+    if it can't hold one batch).
+    """
+    program = make_repeat_program(
+        wl, cfg, max_steps, n_seeds, seed_mod, layout, time32, shrink, min_size
+    )
+    # calibration: one single-batch dispatch (after the compile run)
+    jax.block_until_ready(program(np.uint64(seed_base), 1))
+    t0 = time.perf_counter()
+    jax.block_until_ready(program(np.uint64(seed_base), 1))
+    cal_wall = time.perf_counter() - t0
+
+    repeats = max(1, int(np.ceil(target_wall_s / max(cal_wall, 1e-6))))
+    repeats = min(_round_up_pow2(repeats), max_repeats)
+
+    walls, sims, ovf_tot, halted_min = [], [], 0, None
+    for m in range(n_measure):
+        base = np.uint64(seed_base + (m + 1) * repeats * n_seeds)
+        t0 = time.perf_counter()
+        sim_ns, ovf, halted = jax.block_until_ready(program(base, repeats))
+        walls.append(time.perf_counter() - t0)
+        sims.append(int(sim_ns) / 1e9)
+        ovf_tot += int(ovf)
+        h = int(halted)
+        halted_min = h if halted_min is None else min(halted_min, h)
+
+    # rate per dispatch = its OWN simulated seconds / its wall (seed
+    # blocks differ, so sim time varies slightly across dispatches)
+    rates = np.asarray(sims) / np.asarray(walls)
+    return {
+        "n_seeds": n_seeds,
+        "repeats": int(repeats),
+        "calibration_wall_s": round(cal_wall, 4),
+        "dispatch_walls_s": [round(w, 4) for w in walls],
+        "sim_s_per_dispatch": [round(s, 3) for s in sims],
+        "sim_s_per_s_median": round(float(np.median(rates)), 1),
+        "sim_s_per_s_min": round(float(rates.min()), 1),
+        "sim_s_per_s_max": round(float(rates.max()), 1),
+        "spread_pct": round(
+            100.0 * (rates.max() - rates.min()) / max(float(np.median(rates)), 1e-9),
+            1,
+        ),
+        "overflow": ovf_tot,
+        "all_halted": halted_min == repeats * n_seeds,
+    }
+
+
+def null_dispatch_stats(n: int = 20) -> dict:
+    """Per-dispatch overhead floor: time a trivial jitted kernel.
+
+    The result bounds how much of any measured cell is transport, not
+    compute — quote it alongside sweep artifacts so a reader can check
+    that cells were sized to dominate it.
+    """
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((), jnp.int32)
+    jax.block_until_ready(f(x))
+    walls = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        walls.append(time.perf_counter() - t0)
+    w = np.asarray(walls)
+    return {
+        "n": n,
+        "min_ms": round(float(w.min()) * 1e3, 3),
+        "median_ms": round(float(np.median(w)) * 1e3, 3),
+        "p90_ms": round(float(np.quantile(w, 0.9)) * 1e3, 3),
+        "max_ms": round(float(w.max()) * 1e3, 3),
+    }

@@ -22,3 +22,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the suite's cost is dominated by
+# recompiles of the engine step across parameterized cases and repeat
+# runs (test_engine.py alone was ~405 s cold). The cache survives
+# across pytest invocations, so `make check` pays compile cost once.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -238,6 +238,12 @@ def child(config: str) -> None:
     wl, cfg = factory(), EngineConfig(**cfg_kwargs)
 
     init = make_init(wl, cfg)
+
+    # one min_size policy for BOTH platforms, so a config's accelerator
+    # and CPU numbers describe the same compaction program
+    def _min_size(s: int) -> int:
+        return min(2048, max(s // 4, 1))
+
     # seed compaction (engine/compact.py): halted rows leave the batch in
     # static shrink-steps, so the straggler tail doesn't bill every seed.
     # Per-seed values are bit-identical to the lockstep loop
@@ -246,11 +252,11 @@ def child(config: str) -> None:
     # device->host transfer + reassembly (`run.assemble`) after it —
     # the same methodology as timing the old lockstep SimState run and
     # reading .now afterwards.
-    run = make_run_compacted(
-        wl, cfg, n_steps, min_size=2048, fields=("now", "overflow")
-    )
-
     if jax.devices()[0].platform == "cpu" and n_seeds > CPU_CALIBRATE_SEEDS:
+        run = make_run_compacted(
+            wl, cfg, n_steps,
+            min_size=_min_size(CPU_CALIBRATE_SEEDS), fields=("now", "overflow"),
+        )
         # time-budgeted fallback sizing: measure a small batch, then run
         # the largest power-of-two batch that fits the budget (per-seed
         # cost is ~flat above the calibration size, so this estimate is
@@ -285,7 +291,7 @@ def child(config: str) -> None:
         seed_mod = 524288 if config == "raft" else 131072
         rec = measure_throughput(
             wl, cfg, n_steps, n_seeds, target_wall_s=5.0, n_measure=5,
-            seed_mod=seed_mod, min_size=min(2048, max(n_seeds // 4, 1)),
+            seed_mod=seed_mod, min_size=_min_size(n_seeds),
         )
         # the small pool sizes are only valid while nothing overflows; a
         # silent drop would skew the metric. Reported as a distinct
@@ -316,6 +322,12 @@ def child(config: str) -> None:
         )
         return
 
+    # (re)build the runner at the final seed count's min_size — the CPU
+    # sizing above may have shrunk n_seeds, and the small-batch path
+    # never built one
+    run = make_run_compacted(
+        wl, cfg, n_steps, min_size=_min_size(n_seeds), fields=("now", "overflow")
+    )
     state = init(np.arange(n_seeds, dtype=np.uint64))
     jax.block_until_ready(run.compute(state))  # warm-up compile
 

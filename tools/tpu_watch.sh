@@ -13,9 +13,18 @@ while true; do
   if BENCH_CHILD=probe BENCH_PLATFORM=default timeout "$PROBE_TIMEOUT" \
      python bench.py 2>/dev/null | grep -q '"ok": true'; then
     echo "$(date -u +%H:%M:%S) TPU UP — running bench.py" >&2
-    BENCH_BUDGET=2400 python bench.py > "$OUT" 2>> /tmp/bench_watch.err
-    echo "$(date -u +%H:%M:%S) bench done -> $OUT" >&2
-    exit 0
+    BENCH_BUDGET=2400 python bench.py > "$OUT.tmp" 2>> /tmp/bench_watch.err
+    # keep the artifact only if the headline actually ran on the
+    # accelerator — a mid-bench wedge degrades to a CPU fallback, and
+    # spending the session's one TPU window on that would defeat the
+    # watcher. On CPU output: save nothing, keep looping.
+    if tail -1 "$OUT.tmp" | grep -vq '"platform": "cpu"'; then
+      mv "$OUT.tmp" "$OUT"
+      echo "$(date -u +%H:%M:%S) TPU bench done -> $OUT" >&2
+      exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) bench degraded to CPU; resuming watch" >&2
+    rm -f "$OUT.tmp"
   fi
   sleep "$INTERVAL"
 done

@@ -16,6 +16,11 @@ half runs in a subprocess pinned to the cpu backend)
 
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
 import json
 import os
 import subprocess
@@ -51,7 +56,8 @@ def child() -> None:
     import numpy as np
 
     from madsim_tpu.engine import EngineConfig, make_init, make_run
-    from madsim_tpu.models import BENCH_SPECS
+    from madsim_tpu.engine.compact import make_run_compacted
+    from madsim_tpu.models import BENCH_SPECS, make_twophase
 
     n_seeds = int(os.environ["CROSS_SEEDS"])
     seeds = np.arange(n_seeds, dtype=np.uint64)
@@ -64,16 +70,43 @@ def child() -> None:
     # (raftlog's 4000 in BENCH_SPECS is a run_while chaos-tail cap; its
     # seeds halt well under 400 lockstep steps — tests/test_engine.py)
     step_cap = {"raft": 400, "broadcast": 400, "kvchaos": 700, "raftlog": 400}
-    for name, (factory, cfg_kwargs, _seeds, spec_steps) in BENCH_SPECS.items():
+    # the 7th workload family (not a bench config, but the artifact
+    # certifies every oracle-covered family): two-phase commit, the
+    # oracle-suite configuration (tests/test_oracle.py)
+    specs = dict(BENCH_SPECS)
+    specs["twophase"] = (
+        lambda: make_twophase(txns=4),
+        dict(pool_size=64, loss_p=0.03),
+        None,
+        500,
+    )
+    for name, (factory, cfg_kwargs, _seeds, spec_steps) in specs.items():
         wl, cfg = factory(), EngineConfig(**cfg_kwargs)
-        run = jax.jit(make_run(wl, cfg, step_cap.get(name, spec_steps)))
-        res = jax.block_until_ready(run(make_init(wl, cfg)(seeds)))
-        out["configs"][name] = {
+        steps = step_cap.get(name, spec_steps)
+        st0 = make_init(wl, cfg)(seeds)  # one init serves both runners
+        run = jax.jit(make_run(wl, cfg, steps))
+        res = jax.block_until_ready(run(st0))
+        rec = {
             f: np.asarray(getattr(res, f)).astype(np.uint64).tolist()
             if f == "trace"
             else np.asarray(getattr(res, f)).astype(np.int64).tolist()
             for f in FIELDS
         }
+        # the compacted runner is the path bench.py actually times:
+        # certify it cross-backend too (per-seed values are asserted
+        # bit-identical to lockstep by tests/test_compact.py; here the
+        # same banked fields must also agree across backends)
+        crun = make_run_compacted(
+            wl, cfg, steps, min_size=max(n_seeds // 4, 16), fields=FIELDS
+        )
+        cres = crun(st0)
+        for f in FIELDS:
+            rec["compact_" + f] = (
+                np.asarray(getattr(cres, f)).astype(np.uint64).tolist()
+                if f == "trace"
+                else np.asarray(getattr(cres, f)).astype(np.int64).tolist()
+            )
+        out["configs"][name] = rec
     print(json.dumps(out))
 
 
@@ -101,7 +134,8 @@ def main() -> None:
     }
     for name in acc["configs"]:
         diverged = []
-        for f in FIELDS:
+        # every emitted field: the lockstep set plus its compact_* twins
+        for f in acc["configs"][name]:
             a, c = acc["configs"][name][f], cpu["configs"][name][f]
             n_bad = sum(1 for x, y in zip(a, c) if x != y)
             if n_bad:

@@ -61,7 +61,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from .rng import (
-    PURPOSE_CLOG_JITTER,
     PURPOSE_LATENCY,
     PURPOSE_LOSS,
     PURPOSE_POLL_COST,
@@ -788,8 +787,12 @@ def make_step(
 
         now = jnp.where(active, ev_t, st.now)
         draw = Draw(st.seed, st.step)
-        # per-event processing cost, 50-100 ns (task.rs:213)
-        cost = draw.uniform_int(cfg.proc_min_ns, cfg.proc_max_ns, PURPOSE_POLL_COST)
+        # per-event processing cost, 50-100 ns (task.rs:213), paired
+        # with the clog-recheck jitter in ONE threefry block (lane 0 =
+        # cost, lane 1 = jitter) — same bits2 pairing as latency/loss
+        cost, clog_jit = draw.uniform_int2(
+            cfg.proc_min_ns, cfg.proc_max_ns, 0, 1000, PURPOSE_POLL_COST
+        )
         now_after = jnp.where(dispatch, now + cost, now)
 
         # ---- consume / reschedule the popped slot ----
@@ -803,7 +806,7 @@ def make_step(
             jnp.int64(cfg.clog_backoff_min_ns) << shift,
             jnp.int64(cfg.clog_backoff_max_ns),
         )
-        backoff = backoff + draw.uniform_int(0, 1000, PURPOSE_CLOG_JITTER)
+        backoff = backoff + clog_jit
         resched = active & blocked & (is_engine | live)
         if time32:
             # rebase every offset by this step's clock advance so the

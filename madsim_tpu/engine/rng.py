@@ -53,8 +53,14 @@ _PARITY = np.uint32(0x1BD11BDA)
 
 # Engine purpose namespace. One event-step makes at most one draw per
 # purpose, so (seed, step, purpose) uniquely keys every draw in a run.
-PURPOSE_POLL_COST = 0  # 50-100 ns per-event processing cost
-PURPOSE_CLOG_JITTER = 1  # clogged-link recheck jitter
+# ONE block at PURPOSE_POLL_COST yields both the per-event processing
+# cost (lane 0, 50-100 ns) and the clogged-link recheck jitter (lane 1)
+# via Draw.bits2 — the same pairing the per-emit latency/loss draws use.
+PURPOSE_POLL_COST = 0
+# reserved/legacy: the engine no longer draws a separate block here (the
+# jitter rides PURPOSE_POLL_COST lane 1), but the purpose id stays
+# unavailable so old and new layouts never alias.
+PURPOSE_CLOG_JITTER = 1
 # per-emit-slot draws: ONE block at PURPOSE_LATENCY+s yields both the
 # latency (lane 0) and loss (lane 1) words via Draw.bits2. PURPOSE_LOSS
 # is reserved/legacy space: the engine no longer draws there, but the
@@ -161,10 +167,22 @@ class Draw:
         Uses modulo reduction — a ≤2^-32 bias, identical in the oracle,
         matching the determinism contract (exactness over de-biasing).
         """
+        return self._reduce(self.bits(purpose), lo, hi)
+
+    def uniform_int2(self, lo_a, hi_a, lo_b, hi_b, purpose):
+        """Two independent uniform int64s from ONE threefry block:
+        lane 0 reduced into [lo_a, hi_a), lane 1 into [lo_b, hi_b).
+        The engine pairs the per-step poll-cost and clog-jitter draws
+        this way; the C++ oracle mirrors the pairing exactly."""
+        a, b = self.bits2(purpose)
+        return self._reduce(a, lo_a, hi_a), self._reduce(b, lo_b, hi_b)
+
+    @staticmethod
+    def _reduce(bits, lo, hi):
         span = (jnp.asarray(hi, jnp.int64) - jnp.asarray(lo, jnp.int64)).astype(
             jnp.uint32
         )
-        v = self.bits(purpose) % jnp.maximum(span, jnp.uint32(1))
+        v = bits % jnp.maximum(span, jnp.uint32(1))
         return jnp.asarray(lo, jnp.int64) + v.astype(jnp.int64)
 
     def chance(self, threshold_u32, purpose):

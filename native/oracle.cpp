@@ -77,6 +77,19 @@ struct Draw {
     if (span == 0) span = 1;
     return lo + static_cast<int64_t>(bits(purpose) % span);
   }
+  // two uniform int64s from ONE block (engine Draw.uniform_int2):
+  // lane 0 -> [lo_a, hi_a), lane 1 -> [lo_b, hi_b)
+  void uniform_int2(int64_t lo_a, int64_t hi_a, int64_t lo_b, int64_t hi_b,
+                    uint32_t purpose, int64_t* out_a, int64_t* out_b) const {
+    uint32_t a, b;
+    bits2(purpose, &a, &b);
+    uint32_t span_a = static_cast<uint32_t>(hi_a - lo_a);
+    if (span_a == 0) span_a = 1;
+    uint32_t span_b = static_cast<uint32_t>(hi_b - lo_b);
+    if (span_b == 0) span_b = 1;
+    *out_a = lo_a + static_cast<int64_t>(a % span_a);
+    *out_b = lo_b + static_cast<int64_t>(b % span_b);
+  }
   uint32_t user(uint32_t purpose) const { return bits(kPurposeUser + purpose); }
   int64_t user_int(int64_t lo, int64_t hi, uint32_t purpose) const {
     return uniform_int(lo, hi, kPurposeUser + purpose);
@@ -250,7 +263,12 @@ struct Sim {
     if (active) now = ev_t;
     Draw draw{static_cast<uint32_t>(seed & 0xFFFFFFFFull),
               static_cast<uint32_t>(seed >> 32), step};
-    int64_t cost = draw.uniform_int(cfg.proc_min_ns, cfg.proc_max_ns, kPurposePollCost);
+    // poll cost paired with clog jitter in ONE block (engine
+    // Draw.uniform_int2 at PURPOSE_POLL_COST: lane 0 = cost, lane 1 =
+    // jitter)
+    int64_t cost, clog_jit;
+    draw.uniform_int2(cfg.proc_min_ns, cfg.proc_max_ns, 0, 1000,
+                      kPurposePollCost, &cost, &clog_jit);
     int64_t now_after = dispatch ? now + cost : now;
 
     // consume / clog-reschedule (engine: resched branch)
@@ -258,7 +276,7 @@ struct Sim {
     int64_t shift = retries < 34 ? retries : 34;
     int64_t backoff = cfg.clog_backoff_min_ns << shift;
     if (backoff > cfg.clog_backoff_max_ns) backoff = cfg.clog_backoff_max_ns;
-    backoff += draw.uniform_int(0, 1000, kPurposeClogJitter);
+    backoff += clog_jit;
     bool resched = active && blocked && (is_engine || live);
     ev[i].valid = resched;
     if (resched) {

@@ -58,3 +58,22 @@ def test_null_dispatch_stats_shape():
     s = null_dispatch_stats(n=5)
     assert s["n"] == 5
     assert 0 <= s["min_ms"] <= s["median_ms"] <= s["max_ms"]
+
+
+def test_bench_configs_mirror_bench_specs():
+    """bench.py's parent stays jax-free by mirroring the seed/step table
+    of models.BENCH_SPECS; drift between the two would silently measure
+    a different spec than the one certified by the oracle artifacts."""
+    import importlib.util
+    import os
+
+    from madsim_tpu.models import BENCH_SPECS
+
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert set(bench.CONFIGS) == set(BENCH_SPECS)
+    for name, (n_seeds, n_steps) in bench.CONFIGS.items():
+        _f, _cfg, spec_seeds, spec_steps = BENCH_SPECS[name]
+        assert (n_seeds, n_steps) == (spec_seeds, spec_steps), name

@@ -1,0 +1,79 @@
+#!/bin/bash
+# Full TPU artifact chain, highest-value first (the tunnel historically
+# survives ~15 min after recovering): headline bench -> cross-backend
+# determinism -> scaling sweep -> step ablation. Every step banks its
+# artifact and a done-marker as it completes, so a mid-chain wedge
+# keeps the wins already banked and a re-run (the watcher retries on a
+# nonzero exit) resumes at the first missing step instead of repeating
+# finished ones. Called by tpu_watch.sh; safe to run by hand.
+# Usage: tools/tpu_chain.sh [stamp]   (default r04)
+set -u
+cd "$(dirname "$0")/.."
+STAMP="${1:-r04}"
+case "$STAMP" in
+  *.jsonl|*/*) echo "usage: tpu_chain.sh [stamp] — got a path: $STAMP" >&2; exit 2 ;;
+esac
+MARK="/tmp/tpu_chain_${STAMP}"
+fail=0
+
+if [ -f "BENCH_TPU_${STAMP}.jsonl" ]; then
+  echo "$(date -u +%H:%M:%S) chain: bench already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: bench" >&2
+  BENCH_BUDGET=1500 python bench.py > "BENCH_TPU_${STAMP}.jsonl.tmp" \
+    2>> /tmp/bench_watch.err
+  if tail -1 "BENCH_TPU_${STAMP}.jsonl.tmp" | grep -vq '"platform": "cpu"'; then
+    mv "BENCH_TPU_${STAMP}.jsonl.tmp" "BENCH_TPU_${STAMP}.jsonl"
+    echo "$(date -u +%H:%M:%S) chain: TPU bench banked" >&2
+  else
+    rm -f "BENCH_TPU_${STAMP}.jsonl.tmp"
+    echo "$(date -u +%H:%M:%S) chain: bench degraded to CPU, aborting chain" >&2
+    exit 1
+  fi
+fi
+
+if [ -f "${MARK}.cross.done" ]; then
+  echo "$(date -u +%H:%M:%S) chain: cross-backend already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: cross-backend determinism" >&2
+  # outer timeout > the script's own 2x900s subprocess budget
+  if timeout 2100 python examples/cross_backend_check.py 256 CROSS_BACKEND.json \
+      >> /tmp/bench_watch.err 2>&1; then
+    touch "${MARK}.cross.done"
+    echo "$(date -u +%H:%M:%S) chain: CROSS_BACKEND banked" >&2
+  else
+    echo "$(date -u +%H:%M:%S) chain: cross-backend FAILED (rc=$?)" >&2
+    fail=1
+  fi
+fi
+
+if [ -f "${MARK}.sweep.done" ]; then
+  echo "$(date -u +%H:%M:%S) chain: sweep already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: scaling sweep" >&2
+  if timeout 3000 python examples/scaling_sweep.py SCALING_SWEEP.json \
+      > "SWEEP_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err; then
+    touch "${MARK}.sweep.done"
+    echo "$(date -u +%H:%M:%S) chain: sweep banked" >&2
+  else
+    echo "$(date -u +%H:%M:%S) chain: sweep FAILED (rc=$?, partial rows kept)" >&2
+    fail=1
+  fi
+fi
+
+if [ -f "${MARK}.profile.done" ]; then
+  echo "$(date -u +%H:%M:%S) chain: profile already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: step ablation profile" >&2
+  if timeout 1800 python examples/profile_step.py 65536 \
+      > "PROFILE_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err; then
+    touch "${MARK}.profile.done"
+    echo "$(date -u +%H:%M:%S) chain: profile banked" >&2
+  else
+    echo "$(date -u +%H:%M:%S) chain: profile FAILED (rc=$?, partial rows kept)" >&2
+    fail=1
+  fi
+fi
+
+echo "$(date -u +%H:%M:%S) chain: done (fail=$fail)" >&2
+exit "$fail"

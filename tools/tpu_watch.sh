@@ -1,10 +1,15 @@
 #!/bin/bash
 # Probe the TPU tunnel every PROBE_INTERVAL seconds; the moment it
-# answers, immediately capture the round's TPU bench artifact (the
-# tunnel historically wedges again within ~15 min — see SCALING.md §0).
-# Usage: tools/tpu_watch.sh OUT.jsonl [probe_interval_s] [probe_timeout_s]
+# answers, run the full artifact chain (tools/tpu_chain.sh: bench ->
+# cross-backend -> sweep -> ablation). The chain banks each artifact as
+# it completes, so a mid-chain wedge keeps the earlier wins; if the
+# headline bench itself degraded to CPU the watch resumes.
+# Usage: tools/tpu_watch.sh [stamp] [probe_interval_s] [probe_timeout_s]
 set -u
-OUT="${1:?usage: tpu_watch.sh OUT.jsonl [interval] [timeout]}"
+STAMP="${1:-r04}"
+case "$STAMP" in
+  *.jsonl|*/*) echo "usage: tpu_watch.sh [stamp] — got a path: $STAMP" >&2; exit 2 ;;
+esac
 INTERVAL="${2:-600}"
 PROBE_TIMEOUT="${3:-60}"
 cd "$(dirname "$0")/.."
@@ -12,19 +17,12 @@ while true; do
   echo "$(date -u +%H:%M:%S) probing tpu..." >&2
   if BENCH_CHILD=probe BENCH_PLATFORM=default timeout "$PROBE_TIMEOUT" \
      python bench.py 2>/dev/null | grep -q '"ok": true'; then
-    echo "$(date -u +%H:%M:%S) TPU UP — running bench.py" >&2
-    BENCH_BUDGET=2400 python bench.py > "$OUT.tmp" 2>> /tmp/bench_watch.err
-    # keep the artifact only if the headline actually ran on the
-    # accelerator — a mid-bench wedge degrades to a CPU fallback, and
-    # spending the session's one TPU window on that would defeat the
-    # watcher. On CPU output: save nothing, keep looping.
-    if tail -1 "$OUT.tmp" | grep -vq '"platform": "cpu"'; then
-      mv "$OUT.tmp" "$OUT"
-      echo "$(date -u +%H:%M:%S) TPU bench done -> $OUT" >&2
+    echo "$(date -u +%H:%M:%S) TPU UP — running artifact chain" >&2
+    if tools/tpu_chain.sh "$STAMP"; then
+      echo "$(date -u +%H:%M:%S) chain complete (all artifacts banked)" >&2
       exit 0
     fi
-    echo "$(date -u +%H:%M:%S) bench degraded to CPU; resuming watch" >&2
-    rm -f "$OUT.tmp"
+    echo "$(date -u +%H:%M:%S) chain incomplete; resuming watch (banked steps skip on retry)" >&2
   fi
   sleep "$INTERVAL"
 done

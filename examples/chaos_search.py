@@ -13,6 +13,8 @@ Usage:  python examples/chaos_search.py [n_seeds]
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import sys
 import time
 
@@ -68,6 +70,19 @@ def main() -> None:
         )
         assert solo.failing_seeds.tolist() == [bad]
         print(f"seed {bad} reproduced in isolation (identical trace)")
+
+        # the debug loop's last mile: replay the failing schedule into a
+        # readable timeline (engine/replay.py — the C++ oracle logs the
+        # exact tuples the trace hash folds, so this story IS the trace)
+        from madsim_tpu.engine import format_timeline, refold, replay
+
+        events, res = replay(
+            wl, cfg, bad, 900, writes=writes, n_replicas=n_replicas
+        )
+        assert refold(events, wl) == res.trace
+        tail = events[-12:]
+        print(f"\nlast {len(tail)} of {len(events)} events of seed {bad}:")
+        print(format_timeline(tail, res, wl))
 
 
 if __name__ == "__main__":

@@ -13,6 +13,8 @@ recovering — the server_crash/client_crash scenarios of the reference's
 test suite as a demo.
 """
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import sys
 
 sys.path.insert(0, ".")

@@ -11,10 +11,7 @@ Prints one JSON object per measurement plus a summary line.
 
 from __future__ import annotations
 
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 import json
 import sys

@@ -10,6 +10,8 @@ path (the UCX/eRPC role, C27/C28):
     python examples/rpc_bench.py
 """
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import asyncio
 import ctypes
 import sys

@@ -5,6 +5,8 @@ A KV store declared with the @service/@rpc decorators (the
 driven by a client with packet loss configured.
 """
 
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
 import sys
 
 sys.path.insert(0, ".")

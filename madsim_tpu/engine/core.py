@@ -409,6 +409,9 @@ class Workload:
     # runtime: a timer emit beyond the int32 horizon is counted into
     # `overflow`, which the bench refuses (bench.py pool_overflow path)
     delay_bound_ns: int | None = None
+    # optional human names for the user handlers (len == len(handlers)),
+    # used only by engine.replay timelines — no effect on execution
+    handler_names: tuple | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the

@@ -1,0 +1,178 @@
+"""Single-seed replay: a failing seed becomes a readable event timeline.
+
+The batched engine's per-seed evidence is a uint64 trace *hash* — great
+for equality checking, useless for a human chasing a bug. The reference
+gives users `tracing` spans per node/task (SURVEY.md §5); the batched
+analog is this module: re-run ONE seed through the C++ oracle with its
+per-dispatch event log attached and print what actually happened, in
+order, with virtual timestamps, node ids and decoded handler names.
+
+The log rows are exactly the tuples the trace hash folds, so
+:func:`refold` recomputes the certified hash from the timeline — the
+test gate proving the human-readable story and the bit-identical
+evidence are the same events (any divergence is an oracle/logging bug).
+
+Typical flow with the chaos search::
+
+    report = search_seeds(wl, cfg, invariant, n_seeds=65536, ...)
+    for seed in report.failing_seeds[:3]:
+        print(format_timeline(*replay(wl, cfg, int(seed), 600, txns=4)))
+"""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import oracle as _oracle
+from .core import FIRST_USER_KIND, _TRACE_MIX, _TRACE_PRIME, EngineConfig, Workload
+
+__all__ = ["ReplayEvent", "replay", "refold", "format_timeline"]
+
+_ENGINE_KIND_NAMES = {
+    0: "KILL",
+    1: "RESTART",
+    2: "CLOG",
+    3: "UNCLOG",
+    4: "CLOG_NODE",
+    5: "UNCLOG_NODE",
+    6: "HALT",
+    7: "NOP",
+    8: "PAUSE",
+    9: "RESUME",
+}
+
+
+@dataclass(frozen=True)
+class ReplayEvent:
+    """One dispatched event: the tuple the trace hash folds."""
+
+    time_ns: int
+    kind: int
+    node: int
+    src: int  # -1 = timer/engine event, else sending node
+    args: tuple
+    pay: tuple
+
+    def kind_name(self, wl: Workload | None = None) -> str:
+        if self.kind < FIRST_USER_KIND:
+            return _ENGINE_KIND_NAMES.get(self.kind, f"engine[{self.kind}]")
+        u = self.kind - FIRST_USER_KIND
+        names = getattr(wl, "handler_names", None) if wl is not None else None
+        if names and u < len(names):
+            return str(names[u])
+        return f"user[{u}]"
+
+
+def replay(
+    wl: Workload,
+    cfg: EngineConfig,
+    seed: int,
+    n_steps: int,
+    cap: int = 4096,
+    **model_kwargs,
+):
+    """Re-run one seed through the oracle with event logging.
+
+    Returns ``(events, result)`` — the dispatched-event list and the
+    oracle's :class:`OracleResult`. The log buffer auto-grows until the
+    full run fits, so the timeline is never silently truncated.
+    ``model_kwargs`` are the workload factory parameters, exactly as
+    for :func:`engine.oracle.run_oracle`.
+    """
+    lib = _oracle.load()
+    lib.oracle_log_count.restype = ctypes.c_int64
+    lib.oracle_set_log.restype = None
+    # declared argtypes so the detach call's plain ints marshal as full
+    # 64-bit values (an unmarked int marshals as 4-byte c_int, which for
+    # the stack-passed 7th arg could leave garbage high bits in cap)
+    _p64 = ctypes.POINTER(ctypes.c_int64)
+    _p32 = ctypes.POINTER(ctypes.c_int32)
+    lib.oracle_set_log.argtypes = [_p64, _p32, _p32, _p32, _p32, _p32,
+                                   ctypes.c_int64]
+    while True:
+        t = np.zeros(cap, np.int64)
+        kind = np.zeros(cap, np.int32)
+        node = np.zeros(cap, np.int32)
+        src = np.zeros(cap, np.int32)
+        args = np.zeros((cap, 4), np.int32)
+        pay = np.zeros((cap, 4), np.int32)
+        lib.oracle_set_log(
+            t.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            kind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            node.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            args.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pay.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(cap),
+        )
+        try:
+            res = _oracle.run_oracle(wl, cfg, seed, n_steps, **model_kwargs)
+            count = int(lib.oracle_log_count())
+        finally:
+            # detach: the buffers die with this frame, a later un-logged
+            # oracle_run must not write through dangling pointers
+            lib.oracle_set_log(None, None, None, None, None, None, 0)
+        if count <= cap:
+            break
+        cap = max(cap * 2, count)
+    events = [
+        ReplayEvent(
+            time_ns=int(t[i]),
+            kind=int(kind[i]),
+            node=int(node[i]),
+            src=int(src[i]),
+            args=tuple(int(x) for x in args[i]),
+            pay=tuple(int(x) for x in pay[i]),
+        )
+        for i in range(count)
+    ]
+    return events, res
+
+
+def refold(events, wl: Workload) -> int:
+    """Recompute the trace hash from a replay's events (engine
+    ``_trace_fold`` semantics). Must equal both the oracle's and the
+    batched engine's trace for the same (seed, config, steps)."""
+    mix = int(_TRACE_MIX)
+    prime = int(_TRACE_PRIME)
+    mask = (1 << 64) - 1
+    trace = 0
+    for e in events:
+        h = (e.time_ns * mix) & mask
+        h ^= (e.kind & 0xFFFFFFFF) << 32
+        h ^= (e.node & 0xFFFFFFFF) << 40
+        h &= mask
+        for j in range(4):  # words past args_words are zero: identical
+            h ^= (e.args[j] & 0xFFFFFFFF) << (8 * j)
+        h &= mask
+        if wl.payload_words > 0:
+            acc = 0
+            for w in range(wl.payload_words):
+                acc += (e.pay[w] & 0xFFFFFFFF) * (mix ^ w)
+            h ^= acc & mask
+        trace = (trace * prime + h) & mask
+    return trace
+
+
+def format_timeline(events, res=None, wl: Workload | None = None) -> str:
+    """Render a replay as text, one dispatched event per line."""
+    lines = []
+    n_args = getattr(wl, "args_words", 4) if wl is not None else 4
+    for e in events:
+        origin = "timer" if e.src < 0 else f"node{e.src}"
+        # positions matter (args[1] == 0 is information): print the
+        # declared width verbatim, never skip zero words
+        argstr = ",".join(str(a) for a in e.args[:n_args])
+        lines.append(
+            f"[{e.time_ns / 1e6:>12.3f}ms] node{e.node} <- "
+            f"{e.kind_name(wl)}({argstr}) from {origin}"
+        )
+    if res is not None:
+        lines.append(
+            f"-- halted={res.halted} at {res.halt_time / 1e6:.3f}ms, "
+            f"{res.msg_count} msgs, trace {res.trace:#018x}"
+        )
+    return "\n".join(lines)

@@ -114,6 +114,7 @@ def make_broadcast(
 
     return Workload(
         name="broadcast",
+        handler_names=("init", "msg", "ack", "retx"),
         n_nodes=n_nodes,
         state_width=4,
         handlers=(on_init, on_msg, on_ack, on_retx),

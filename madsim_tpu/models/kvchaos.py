@@ -257,6 +257,7 @@ def make_kvchaos(
 
     return Workload(
         name="kvchaos-payload" if payload else "kvchaos",
+        handler_names=("init", "write", "repl", "ack", "commit", "retx", "cretx", "fin", "join", "jretx"),
         n_nodes=n,
         state_width=width,
         handlers=(

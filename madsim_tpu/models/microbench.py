@@ -48,6 +48,7 @@ def make_microbench(
 
     return Workload(
         name="microbench",
+        handler_names=("init", "tick"),
         n_nodes=1,
         state_width=4,
         handlers=(on_init, on_tick),

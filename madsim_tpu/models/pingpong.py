@@ -58,6 +58,7 @@ def make_pingpong(rounds: int = 10, n_clients: int = 2) -> Workload:
 
     return Workload(
         name="pingpong",
+        handler_names=("init", "ping", "pong", "done"),
         n_nodes=n,
         state_width=4,
         handlers=(on_init, on_ping, on_pong, on_done),

@@ -135,6 +135,7 @@ def make_raft(
 
     return Workload(
         name="raft-election",
+        handler_names=("init", "timeout", "reqvote", "grant", "heartbeat"),
         n_nodes=n_nodes,
         state_width=6,
         handlers=(on_init, on_timeout, on_reqvote, on_grant, on_heartbeat),

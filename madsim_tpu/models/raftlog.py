@@ -301,6 +301,7 @@ def make_raftlog(
 
     return Workload(
         name="raftlog",
+        handler_names=("init", "timeout", "reqvote", "grant", "append", "ackapp", "propose", "retx"),
         n_nodes=n_nodes,
         state_width=width,
         handlers=(

@@ -261,6 +261,7 @@ def make_twophase(
 
     return Workload(
         name="twophase",
+        handler_names=("init", "prepare", "vote", "decision", "ack", "retx", "hello", "hretx", "resync"),
         n_nodes=n,
         state_width=6,
         handlers=(

@@ -144,6 +144,20 @@ struct Emit {
   int32_t pay[kMaxPay] = {0, 0, 0, 0};
 };
 
+// ---- optional per-dispatch event log (engine/replay.py) -----------------
+// Caller-owned buffers; when set, oracle_run records every DISPATCHED
+// event — exactly the tuples trace_fold consumes, so a timeline built
+// from the log re-folds to the certified trace hash. The count keeps
+// growing past the capacity so the caller can detect truncation.
+int64_t* g_log_time = nullptr;
+int32_t* g_log_kind = nullptr;
+int32_t* g_log_node = nullptr;
+int32_t* g_log_src = nullptr;
+int32_t* g_log_args = nullptr;  // (cap, 4) row-major
+int32_t* g_log_pay = nullptr;   // (cap, kMaxPay) row-major
+int64_t g_log_cap = 0;
+int64_t g_log_count = 0;
+
 struct Effects {
   std::vector<Emit> emits;
   int32_t kill = -1, restart = -1;
@@ -403,7 +417,20 @@ struct Sim {
       std::memcpy(ne.pay, e.pay, sizeof(ne.pay));
     }
     msg_count += n_sends;
-    if (dispatch) trace_fold(now, kind, dst, args, pay);
+    if (dispatch) {
+      trace_fold(now, kind, dst, args, pay);
+      if (g_log_cap > 0) {
+        if (g_log_count < g_log_cap) {
+          g_log_time[g_log_count] = now;
+          g_log_kind[g_log_count] = kind;
+          g_log_node[g_log_count] = dst;
+          g_log_src[g_log_count] = src;
+          std::memcpy(g_log_args + g_log_count * 4, args, sizeof(args));
+          std::memcpy(g_log_pay + g_log_count * kMaxPay, pay, sizeof(pay));
+        }
+        g_log_count++;
+      }
+    }
     now = now_after;
     step += 1;
   }
@@ -1332,6 +1359,7 @@ int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
                    int32_t* out_node_state /* N*U, may be null */) {
   Workload wl = make_workload(workload_id);
   if (wl.n_nodes == 0) return 1;
+  g_log_count = 0;  // each run logs from the start of its buffers
   Sim sim;
   sim.cfg = Config{pool_size, lat_min_ns, lat_max_ns, loss_u32,
                    proc_min_ns, proc_max_ns, clog_backoff_min_ns,
@@ -1362,5 +1390,24 @@ void oracle_threefry2x32(uint32_t k0, uint32_t k1, uint32_t x0, uint32_t x1,
                          uint32_t* o0, uint32_t* o1) {
   threefry2x32(k0, k1, x0, x1, o0, o1);
 }
+
+// Attach caller-owned per-dispatch log buffers (engine/replay.py).
+// args is (cap, 4) row-major; pay is (cap, 4 = kMaxPay) row-major.
+// Pass cap=0 (and nulls) to detach. The next oracle_run fills from 0.
+void oracle_set_log(int64_t* t, int32_t* kind, int32_t* node, int32_t* src,
+                    int32_t* args, int32_t* pay, int64_t cap) {
+  g_log_time = t;
+  g_log_kind = kind;
+  g_log_node = node;
+  g_log_src = src;
+  g_log_args = args;
+  g_log_pay = pay;
+  g_log_cap = cap;
+  g_log_count = 0;
+}
+
+// Dispatched-event count of the last run (may exceed the attached
+// capacity — that means the log was truncated).
+int64_t oracle_log_count() { return g_log_count; }
 
 }  // extern "C"

@@ -47,30 +47,36 @@ else
   fi
 fi
 
-if [ -f "${MARK}.sweep.done" ]; then
+# a step is banked only if its marker AND artifact exist AND the
+# artifact really ran on the accelerator — a mid-chain wedge silently
+# degrades jax to CPU, and banking that would spend the TPU window on
+# numbers the CPU fallback already provides
+if [ -f "${MARK}.sweep.done" ] && [ -f "SWEEP_TPU_${STAMP}.jsonl" ]; then
   echo "$(date -u +%H:%M:%S) chain: sweep already banked, skipping" >&2
 else
   echo "$(date -u +%H:%M:%S) chain: scaling sweep" >&2
   if timeout 3000 python examples/scaling_sweep.py SCALING_SWEEP.json \
-      > "SWEEP_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err; then
+      > "SWEEP_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err \
+      && ! grep -q '"platform": "cpu"' SCALING_SWEEP.json; then
     touch "${MARK}.sweep.done"
     echo "$(date -u +%H:%M:%S) chain: sweep banked" >&2
   else
-    echo "$(date -u +%H:%M:%S) chain: sweep FAILED (rc=$?, partial rows kept)" >&2
+    echo "$(date -u +%H:%M:%S) chain: sweep FAILED or on CPU (partial rows kept)" >&2
     fail=1
   fi
 fi
 
-if [ -f "${MARK}.profile.done" ]; then
+if [ -f "${MARK}.profile.done" ] && [ -f "PROFILE_TPU_${STAMP}.jsonl" ]; then
   echo "$(date -u +%H:%M:%S) chain: profile already banked, skipping" >&2
 else
   echo "$(date -u +%H:%M:%S) chain: step ablation profile" >&2
   if timeout 1800 python examples/profile_step.py 65536 \
-      > "PROFILE_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err; then
+      > "PROFILE_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err \
+      && head -1 "PROFILE_TPU_${STAMP}.jsonl" | grep -vq '"platform": "cpu"'; then
     touch "${MARK}.profile.done"
     echo "$(date -u +%H:%M:%S) chain: profile banked" >&2
   else
-    echo "$(date -u +%H:%M:%S) chain: profile FAILED (rc=$?, partial rows kept)" >&2
+    echo "$(date -u +%H:%M:%S) chain: profile FAILED or on CPU (partial rows kept)" >&2
     fail=1
   fi
 fi

@@ -54,7 +54,7 @@ def child() -> None:
 
     from madsim_tpu.engine import EngineConfig, make_init, make_run
     from madsim_tpu.engine.compact import make_run_compacted
-    from madsim_tpu.models import BENCH_SPECS, make_twophase
+    from madsim_tpu.models import BENCH_SPECS, make_paxos, make_twophase
 
     n_seeds = int(os.environ["CROSS_SEEDS"])
     seeds = np.arange(n_seeds, dtype=np.uint64)
@@ -67,15 +67,22 @@ def child() -> None:
     # (raftlog's 4000 in BENCH_SPECS is a run_while chaos-tail cap; its
     # seeds halt well under 400 lockstep steps — tests/test_engine.py)
     step_cap = {"raft": 400, "broadcast": 400, "kvchaos": 700, "raftlog": 400}
-    # the 7th workload family (not a bench config, but the artifact
-    # certifies every oracle-covered family): two-phase commit, the
-    # oracle-suite configuration (tests/test_oracle.py)
+    # the 7th and 8th workload families (not bench configs, but the
+    # artifact certifies every oracle-covered family): two-phase commit
+    # and single-decree paxos, at the oracle-suite configurations
+    # (tests/test_oracle.py)
     specs = dict(BENCH_SPECS)
     specs["twophase"] = (
         lambda: make_twophase(txns=4),
         dict(pool_size=64, loss_p=0.03),
         None,
         500,
+    )
+    specs["paxos"] = (
+        make_paxos,
+        dict(pool_size=64, loss_p=0.02),
+        None,
+        400,
     )
     for name, (factory, cfg_kwargs, _seeds, spec_steps) in specs.items():
         wl, cfg = factory(), EngineConfig(**cfg_kwargs)

@@ -17,7 +17,6 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 import sys
 
-sys.path.insert(0, ".")
 
 import madsim_tpu as ms
 from madsim_tpu.services import grpc

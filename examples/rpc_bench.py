@@ -17,7 +17,6 @@ import ctypes
 import sys
 import time
 
-sys.path.insert(0, ".")
 
 from madsim_tpu.std import net as std_net
 

@@ -9,7 +9,6 @@ import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 import sys
 
-sys.path.insert(0, ".")
 
 import madsim_tpu as ms
 from madsim_tpu.net import Endpoint

@@ -33,6 +33,7 @@ WORKLOAD_IDS = {
     "kvchaos-payload": 4,  # same C++ workload; payload flag via set_params
     "twophase": 5,
     "raftlog": 6,
+    "paxos": 7,
 }
 
 _lib = None
@@ -137,6 +138,20 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
         )
         if rc:
             raise ValueError("oracle payload arena caps n_writes at 4")
+    elif wl.name == "paxos":
+        lib.oracle_set_paxos(
+            ctypes.c_int32(model_kwargs.get("n_acceptors", 5)),
+            ctypes.c_int32(model_kwargs.get("n_proposers", 3)),
+            ctypes.c_int64(model_kwargs.get("start_min_ns", 5_000_000)),
+            ctypes.c_int64(model_kwargs.get("start_max_ns", 30_000_000)),
+            ctypes.c_int64(model_kwargs.get("timeout_min_ns", 60_000_000)),
+            ctypes.c_int64(model_kwargs.get("timeout_max_ns", 120_000_000)),
+            ctypes.c_int32(1 if model_kwargs.get("chaos", True) else 0),
+            ctypes.c_int64(model_kwargs.get("kill_min_ns", 30_000_000)),
+            ctypes.c_int64(model_kwargs.get("kill_max_ns", 150_000_000)),
+            ctypes.c_int64(model_kwargs.get("revive_min_ns", 80_000_000)),
+            ctypes.c_int64(model_kwargs.get("revive_max_ns", 300_000_000)),
+        )
     else:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")
 

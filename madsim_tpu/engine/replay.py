@@ -26,22 +26,19 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from . import core as _core
 from . import oracle as _oracle
 from .core import FIRST_USER_KIND, _TRACE_MIX, _TRACE_PRIME, EngineConfig, Workload
 
 __all__ = ["ReplayEvent", "replay", "refold", "format_timeline"]
 
+# derived from the KIND_* constants so the timeline can't drift from
+# engine/core.py's numbering (the single source of truth the C++ oracle
+# mirrors too)
 _ENGINE_KIND_NAMES = {
-    0: "KILL",
-    1: "RESTART",
-    2: "CLOG",
-    3: "UNCLOG",
-    4: "CLOG_NODE",
-    5: "UNCLOG_NODE",
-    6: "HALT",
-    7: "NOP",
-    8: "PAUSE",
-    9: "RESUME",
+    v: k[len("KIND_"):]
+    for k, v in vars(_core).items()
+    if k.startswith("KIND_")
 }
 
 

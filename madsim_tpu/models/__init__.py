@@ -17,6 +17,9 @@ five cover the benchmark configs in BASELINE.md:
   7. raftlog     — raft log replication (single-inflight AppendEntries
                    with full-prefix install, lexicographic vote checks,
                    win-time re-stamp) under leader-crash chaos
+  8. paxos       — single-decree Paxos (dueling proposers, NACK
+                   fast-forward, acceptor stable storage) under
+                   proposer-crash chaos
 """
 
 from .microbench import make_microbench  # noqa: F401
@@ -26,6 +29,7 @@ from .raft import make_raft  # noqa: F401
 from .raftlog import make_raftlog  # noqa: F401
 from .kvchaos import make_kvchaos  # noqa: F401
 from .twophase import make_twophase  # noqa: F401
+from .paxos import make_paxos  # noqa: F401
 
 # The BASELINE.md benchmark configurations, shared by bench.py and
 # examples/cross_backend_check.py so the cross-backend determinism

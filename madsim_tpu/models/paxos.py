@@ -1,0 +1,277 @@
+"""Single-decree Paxos with dueling proposers and proposer-crash chaos.
+
+The eighth oracle-verified protocol family: ``n_acceptors`` acceptors
+(nodes ``0..A-1``) and ``n_proposers`` proposers (nodes ``A..A+P-1``)
+run classic synod consensus. Every proposer wants its own value chosen
+(value ``pidx+1``), ballots are globally unique by construction
+(``ballot = round*P + pidx + 1``), and random per-round timeouts break
+the dueling-proposers livelock. Chaos kills one random PROPOSER
+mid-protocol and restarts it later; a reborn proposer re-runs on_init
+with wiped RAM and simply starts proposing again from round 0, getting
+NACK-fast-forwarded to a live ballot. Acceptors are never killed:
+their (promised, accepted) state is the protocol's stable storage, and
+single-decree safety genuinely requires it — killing an acceptor
+models losing its disk, which real Paxos does not survive either.
+
+Message flow (standard synod, with NACKs for liveness):
+
+* PREPARE(b) -> acceptor: grant iff ``b > promised``; reply
+  PROMISE(b, accepted_bal, accepted_val) or NACK(promised).
+* majority of PROMISEs -> proposer adopts the highest-ballot accepted
+  value it heard (or its own if none) and broadcasts ACCEPT(b, v).
+* ACCEPT(b, v) -> acceptor: ok iff ``b >= promised``; accept + reply
+  ACCEPTED(b), else NACK(promised).
+* majority of ACCEPTEDs -> chosen: the proposer records the decision
+  and broadcasts DECIDED(v) to every proposer plus acceptor 0, whose
+  receipt halts the instance.
+* NACK(b') with ``b' > ballot`` abandons the round and fast-forwards
+  the round counter so the next ballot exceeds ``b'``.
+
+Safety invariants checked at halt (tests/test_engine.py and the chaos
+search): **agreement** — every nonzero decided value is the same;
+**validity** — the decision is some proposer's value (1..P); and the
+acceptor-majority witness — at least a majority of acceptors hold
+``accepted_val == decision`` (the choosing majority can only move to
+higher ballots carrying the chosen value).
+
+Acceptor state row: [promised, accepted_bal, accepted_val, 0, ...]
+Proposer state row: [phase(0=idle 1=prepare 2=accept 3=done), ballot,
+                     value, promise_count, best_bal, best_val,
+                     accept_count, decided, round, timer_seq]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+
+_H_INIT = 0
+_H_PROPOSE = 1  # at proposer (timer): args = (tseq,)
+_H_PREPARE = 2  # at acceptor: args = (ballot,)
+_H_PROMISE = 3  # at proposer: args = (ballot, acc_bal, acc_val)
+_H_ACCEPT = 4  # at acceptor: args = (ballot, value)
+_H_ACCEPTED = 5  # at proposer: args = (ballot,)
+_H_DECIDED = 6  # anywhere: args = (value,)
+_H_NACK = 7  # at proposer: args = (promised,)
+
+# acceptor columns
+A_PROM, A_BAL, A_VAL = 0, 1, 2
+# proposer columns
+P_PHASE, P_BAL, P_VAL, P_PCNT, P_BESTB, P_BESTV, P_ACNT, P_DEC, P_ROUND, P_TSEQ = (
+    range(10)
+)
+IDLE, PREPARING, ACCEPTING, DONE = 0, 1, 2, 3
+
+# user draw purposes
+_P_START = 0
+_P_TIMEOUT = 1
+_P_KILL_AT = 2
+_P_KILL_WHO = 3
+_P_REVIVE = 4
+
+
+def make_paxos(
+    n_acceptors: int = 5,
+    n_proposers: int = 3,
+    start_min_ns: int = 5_000_000,
+    start_max_ns: int = 30_000_000,
+    timeout_min_ns: int = 60_000_000,
+    timeout_max_ns: int = 120_000_000,
+    chaos: bool = True,
+    kill_min_ns: int = 30_000_000,
+    kill_max_ns: int = 150_000_000,
+    revive_min_ns: int = 80_000_000,
+    revive_max_ns: int = 300_000_000,
+) -> Workload:
+    a, p = n_acceptors, n_proposers
+    n = a + p
+    majority = a // 2 + 1
+    acceptors = list(range(a))
+    proposers = list(range(a, n))
+
+    def _is_prop(node):
+        return node >= jnp.int32(a)
+
+    def _pidx(node):
+        return node - jnp.int32(a)
+
+    def _arm(ctx, eb, tseq, when, lo, hi, purpose):
+        d = ctx.draw.user_int(lo, hi, purpose)
+        eb.after(d, user_kind(_H_PROPOSE), ctx.node, (tseq,), when=when)
+
+    def on_init(ctx):
+        st = ctx.state
+        is_prop = _is_prop(ctx.node)
+        eb = ctx.emits()
+        _arm(ctx, eb, jnp.int32(1), is_prop, start_min_ns, start_max_ns, _P_START)
+        if chaos:
+            # acceptor 0's t=0 init schedules the seed's chaos plan: one
+            # PROPOSER killed and later restarted (acceptors are stable
+            # storage — see module docstring)
+            first = (ctx.node == jnp.int32(0)) & (ctx.now == 0)
+            who = jnp.int32(a) + ctx.draw.user_int(0, p, _P_KILL_WHO).astype(
+                jnp.int32
+            )
+            at = ctx.draw.user_int(kill_min_ns, kill_max_ns, _P_KILL_AT)
+            revive = ctx.draw.user_int(revive_min_ns, revive_max_ns, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=first)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=first)
+        new = jnp.where(is_prop, st.at[P_TSEQ].set(1), st)
+        return new, eb.build()
+
+    def on_propose(ctx):
+        st = ctx.state
+        fire = (
+            (ctx.args[0] == st[P_TSEQ])
+            & (st[P_DEC] == jnp.int32(0))
+            & _is_prop(ctx.node)
+        )
+        ballot = st[P_ROUND] * jnp.int32(p) + _pidx(ctx.node) + jnp.int32(1)
+        new = jnp.where(
+            fire,
+            st.at[P_PHASE].set(PREPARING)
+            .at[P_BAL].set(ballot)
+            .at[P_PCNT].set(0)
+            .at[P_BESTB].set(0)
+            .at[P_BESTV].set(0)
+            .at[P_ACNT].set(0)
+            .at[P_ROUND].set(st[P_ROUND] + 1)
+            .at[P_TSEQ].set(st[P_TSEQ] + 1),
+            st,
+        )
+        eb = ctx.emits()
+        for acc in acceptors:
+            eb.send(acc, user_kind(_H_PREPARE), (ballot,), when=fire)
+        # the retry chain: a fresh timer per attempt, tseq-guarded so
+        # only the latest fires (stale timers are no-ops)
+        _arm(
+            ctx, eb, st[P_TSEQ] + 1, fire,
+            timeout_min_ns, timeout_max_ns, _P_TIMEOUT,
+        )
+        return new, eb.build()
+
+    def on_prepare(ctx):
+        st = ctx.state
+        b = ctx.args[0]
+        grant = b > st[A_PROM]
+        new = jnp.where(grant, st.at[A_PROM].set(b), st)
+        eb = ctx.emits()
+        eb.send(
+            ctx.src, user_kind(_H_PROMISE), (b, st[A_BAL], st[A_VAL]), when=grant
+        )
+        eb.send(ctx.src, user_kind(_H_NACK), (st[A_PROM],), when=~grant)
+        return new, eb.build()
+
+    def on_promise(ctx):
+        st = ctx.state
+        b, abal, aval = ctx.args[0], ctx.args[1], ctx.args[2]
+        relevant = (st[P_PHASE] == jnp.int32(PREPARING)) & (b == st[P_BAL])
+        pcnt = jnp.where(relevant, st[P_PCNT] + 1, st[P_PCNT])
+        better = relevant & (abal > st[P_BESTB])
+        bestb = jnp.where(better, abal, st[P_BESTB])
+        bestv = jnp.where(better, aval, st[P_BESTV])
+        won = relevant & (pcnt >= jnp.int32(majority))
+        # paxos's value rule: adopt the highest-ballot accepted value
+        # heard in the promise quorum, else propose our own
+        own = _pidx(ctx.node) + jnp.int32(1)
+        value = jnp.where(bestb > 0, bestv, own)
+        new = (
+            st.at[P_PCNT].set(pcnt)
+            .at[P_BESTB].set(bestb)
+            .at[P_BESTV].set(bestv)
+            .at[P_PHASE].set(jnp.where(won, jnp.int32(ACCEPTING), st[P_PHASE]))
+            .at[P_VAL].set(jnp.where(won, value, st[P_VAL]))
+            .at[P_ACNT].set(jnp.where(won, 0, st[P_ACNT]))
+        )
+        eb = ctx.emits()
+        for acc in acceptors:
+            eb.send(acc, user_kind(_H_ACCEPT), (b, value), when=won)
+        return new, eb.build()
+
+    def on_accept(ctx):
+        st = ctx.state
+        b, v = ctx.args[0], ctx.args[1]
+        ok = b >= st[A_PROM]
+        new = jnp.where(
+            ok, st.at[A_PROM].set(b).at[A_BAL].set(b).at[A_VAL].set(v), st
+        )
+        eb = ctx.emits()
+        eb.send(ctx.src, user_kind(_H_ACCEPTED), (b,), when=ok)
+        eb.send(ctx.src, user_kind(_H_NACK), (st[A_PROM],), when=~ok)
+        return new, eb.build()
+
+    def on_accepted(ctx):
+        st = ctx.state
+        b = ctx.args[0]
+        relevant = (st[P_PHASE] == jnp.int32(ACCEPTING)) & (b == st[P_BAL])
+        acnt = jnp.where(relevant, st[P_ACNT] + 1, st[P_ACNT])
+        chosen = relevant & (acnt >= jnp.int32(majority))
+        new = (
+            st.at[P_ACNT].set(acnt)
+            .at[P_PHASE].set(jnp.where(chosen, jnp.int32(DONE), st[P_PHASE]))
+            .at[P_DEC].set(jnp.where(chosen, st[P_VAL], st[P_DEC]))
+        )
+        eb = ctx.emits()
+        for prop in proposers:
+            eb.send(
+                prop, user_kind(_H_DECIDED), (st[P_VAL],),
+                when=chosen & (jnp.int32(prop) != ctx.node),
+            )
+        # acceptor 0 is the halt witness: its DECIDED receipt freezes
+        # the instance
+        eb.send(0, user_kind(_H_DECIDED), (st[P_VAL],), when=chosen)
+        return new, eb.build()
+
+    def on_decided(ctx):
+        st = ctx.state
+        v = ctx.args[0]
+        is_prop = _is_prop(ctx.node)
+        new = jnp.where(
+            is_prop,
+            st.at[P_DEC].set(jnp.where(st[P_DEC] == 0, v, st[P_DEC]))
+            .at[P_PHASE].set(DONE),
+            st,
+        )
+        eb = ctx.emits()
+        eb.halt(when=ctx.node == jnp.int32(0))
+        return new, eb.build()
+
+    def on_nack(ctx):
+        st = ctx.state
+        b = ctx.args[0]
+        # a NACK naming a higher ballot kills this round: abandon it and
+        # fast-forward so the next attempt's ballot exceeds what we saw
+        act = (
+            _is_prop(ctx.node)
+            & (b > st[P_BAL])
+            & (st[P_DEC] == jnp.int32(0))
+        )
+        ffwd = b // jnp.int32(p) + jnp.int32(1)
+        new = jnp.where(
+            act,
+            st.at[P_PHASE].set(IDLE)
+            .at[P_ROUND].set(jnp.maximum(st[P_ROUND], ffwd)),
+            st,
+        )
+        return new, ctx.emits().build()
+
+    return Workload(
+        name="paxos",
+        handler_names=(
+            "init", "propose", "prepare", "promise", "accept", "accepted",
+            "decided", "nack",
+        ),
+        n_nodes=n,
+        state_width=10,
+        handlers=(
+            on_init, on_propose, on_prepare, on_promise, on_accept,
+            on_accepted, on_decided, on_nack,
+        ),
+        # widest: on_propose (A prepares + 1 timer); on_accepted sends
+        # P-1 + 1 DECIDEDs; on_init arms 1 timer + 2 chaos events
+        max_emits=max(a + 1, p + 1, 3),
+        # largest timer: the chaos restart at 'at + revive'
+        delay_bound_ns=max(timeout_max_ns, kill_max_ns + revive_max_ns),
+        args_words=3,
+    )

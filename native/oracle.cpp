@@ -1266,6 +1266,160 @@ void raftlog_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   }
 }
 
+// paxos (models/paxos.py): single-decree synod — A acceptors (nodes
+// 0..A-1, never killed: stable storage), P proposers (A..A+P-1) with
+// unique ballots round*P+pidx+1, NACK fast-forward, proposer-crash
+// chaos. Emit-row ORDER mirrors the Python EmitBuilder exactly.
+struct PaxosParams {
+  int32_t n_acceptors, n_proposers;
+  int64_t start_min_ns, start_max_ns, timeout_min_ns, timeout_max_ns;
+  int32_t chaos;
+  int64_t kill_min_ns, kill_max_ns, revive_min_ns, revive_max_ns;
+};
+PaxosParams g_px{5, 3, 5000000, 30000000, 60000000, 120000000,
+                 1, 30000000, 150000000, 80000000, 300000000};
+
+void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t K_PROPOSE = FIRST_USER_KIND + 1,
+                K_PREPARE = FIRST_USER_KIND + 2,
+                K_PROMISE = FIRST_USER_KIND + 3,
+                K_ACCEPT = FIRST_USER_KIND + 4,
+                K_ACCEPTED = FIRST_USER_KIND + 5,
+                K_DECIDED = FIRST_USER_KIND + 6,
+                K_NACK = FIRST_USER_KIND + 7;
+  const int32_t P_START = 0, P_TIMEOUT = 1, P_KILL_AT = 2, P_KILL_WHO = 3,
+                P_REVIVE = 4;
+  const int32_t A = g_px.n_acceptors, P = g_px.n_proposers;
+  const int32_t majority = A / 2 + 1;
+  // proposer state columns (acceptors use 0..2 as promised/bal/val)
+  const int32_t S_PHASE = 0, S_BAL = 1, S_VAL = 2, S_PCNT = 3, S_BESTB = 4,
+                S_BESTV = 5, S_ACNT = 6, S_DEC = 7, S_ROUND = 8, S_TSEQ = 9;
+  const int32_t* st = ctx.state;
+  bool is_prop = ctx.node >= A;
+  switch (h) {
+    case 0: {  // on_init
+      int64_t d = ctx.draw.user_int(g_px.start_min_ns, g_px.start_max_ns,
+                                    P_START);
+      eff->emits.push_back(mk_after(d, K_PROPOSE, ctx.node, 1, is_prop));
+      if (g_px.chaos) {
+        bool first = ctx.node == 0 && ctx.now == 0;
+        int64_t who = A + ctx.draw.user_int(0, P, P_KILL_WHO);
+        int64_t at =
+            ctx.draw.user_int(g_px.kill_min_ns, g_px.kill_max_ns, P_KILL_AT);
+        int64_t revive = ctx.draw.user_int(g_px.revive_min_ns,
+                                           g_px.revive_max_ns, P_REVIVE);
+        eff->emits.push_back(
+            mk_after(at, KIND_KILL, 0, static_cast<int32_t>(who), first));
+        eff->emits.push_back(mk_after(at + revive, KIND_RESTART, 0,
+                                      static_cast<int32_t>(who), first));
+      }
+      if (is_prop) ns[S_TSEQ] = 1;
+      break;
+    }
+    case 1: {  // on_propose (timer at proposer)
+      bool fire = ctx.args[0] == st[S_TSEQ] && st[S_DEC] == 0 && is_prop;
+      int32_t pidx = ctx.node - A;
+      int32_t ballot = st[S_ROUND] * P + pidx + 1;
+      if (fire) {
+        ns[S_PHASE] = 1;  // PREPARING
+        ns[S_BAL] = ballot;
+        ns[S_PCNT] = 0;
+        ns[S_BESTB] = 0;
+        ns[S_BESTV] = 0;
+        ns[S_ACNT] = 0;
+        ns[S_ROUND] = st[S_ROUND] + 1;
+        ns[S_TSEQ] = st[S_TSEQ] + 1;
+      }
+      for (int32_t acc = 0; acc < A; acc++)
+        eff->emits.push_back(mk_send(acc, K_PREPARE, ballot, 0, fire));
+      int64_t d = ctx.draw.user_int(g_px.timeout_min_ns, g_px.timeout_max_ns,
+                                    P_TIMEOUT);
+      eff->emits.push_back(
+          mk_after(d, K_PROPOSE, ctx.node, st[S_TSEQ] + 1, fire));
+      break;
+    }
+    case 2: {  // on_prepare (at acceptor)
+      int32_t b = ctx.args[0];
+      bool grant = b > st[0];
+      if (grant) ns[0] = b;
+      Emit e = mk_send(ctx.src, K_PROMISE, b, st[1], grant);
+      e.args[2] = st[2];
+      eff->emits.push_back(e);
+      eff->emits.push_back(mk_send(ctx.src, K_NACK, st[0], 0, !grant));
+      break;
+    }
+    case 3: {  // on_promise (at proposer)
+      int32_t b = ctx.args[0], abal = ctx.args[1], aval = ctx.args[2];
+      bool relevant = st[S_PHASE] == 1 && b == st[S_BAL];
+      int32_t pcnt = relevant ? st[S_PCNT] + 1 : st[S_PCNT];
+      bool better = relevant && abal > st[S_BESTB];
+      int32_t bestb = better ? abal : st[S_BESTB];
+      int32_t bestv = better ? aval : st[S_BESTV];
+      bool won = relevant && pcnt >= majority;
+      int32_t own = ctx.node - A + 1;
+      int32_t value = bestb > 0 ? bestv : own;
+      ns[S_PCNT] = pcnt;
+      ns[S_BESTB] = bestb;
+      ns[S_BESTV] = bestv;
+      if (won) {
+        ns[S_PHASE] = 2;  // ACCEPTING
+        ns[S_VAL] = value;
+        ns[S_ACNT] = 0;
+      }
+      for (int32_t acc = 0; acc < A; acc++)
+        eff->emits.push_back(mk_send(acc, K_ACCEPT, b, value, won));
+      break;
+    }
+    case 4: {  // on_accept (at acceptor)
+      int32_t b = ctx.args[0], v = ctx.args[1];
+      bool ok = b >= st[0];
+      if (ok) {
+        ns[0] = b;
+        ns[1] = b;
+        ns[2] = v;
+      }
+      eff->emits.push_back(mk_send(ctx.src, K_ACCEPTED, b, 0, ok));
+      eff->emits.push_back(mk_send(ctx.src, K_NACK, st[0], 0, !ok));
+      break;
+    }
+    case 5: {  // on_accepted (at proposer)
+      int32_t b = ctx.args[0];
+      bool relevant = st[S_PHASE] == 2 && b == st[S_BAL];
+      int32_t acnt = relevant ? st[S_ACNT] + 1 : st[S_ACNT];
+      bool chosen = relevant && acnt >= majority;
+      ns[S_ACNT] = acnt;
+      if (chosen) {
+        ns[S_PHASE] = 3;  // DONE
+        ns[S_DEC] = st[S_VAL];
+      }
+      for (int32_t prop = A; prop < A + P; prop++)
+        eff->emits.push_back(mk_send(prop, K_DECIDED, st[S_VAL], 0,
+                                     chosen && prop != ctx.node));
+      eff->emits.push_back(mk_send(0, K_DECIDED, st[S_VAL], 0, chosen));
+      break;
+    }
+    case 6: {  // on_decided
+      int32_t v = ctx.args[0];
+      if (is_prop) {
+        ns[S_DEC] = st[S_DEC] == 0 ? v : st[S_DEC];
+        ns[S_PHASE] = 3;
+      }
+      eff->emits.push_back(mk_after(0, KIND_HALT, 0, 0, ctx.node == 0));
+      break;
+    }
+    case 7: {  // on_nack (at proposer)
+      int32_t b = ctx.args[0];
+      bool act = is_prop && b > st[S_BAL] && st[S_DEC] == 0;
+      if (act) {
+        int32_t ffwd = b / P + 1;
+        ns[S_PHASE] = 0;  // IDLE
+        ns[S_ROUND] = st[S_ROUND] > ffwd ? st[S_ROUND] : ffwd;
+      }
+      break;
+    }
+  }
+}
+
 Workload make_workload(int32_t id) {
   switch (id) {
     case 0:  // pingpong
@@ -1294,6 +1448,13 @@ Workload make_workload(int32_t id) {
     case 6:  // raftlog: max_emits = N + 2 (grant: N appends + 2 timers)
       return Workload{g_rl.n_nodes, 8 + g_rl.n_writes, 8, g_rl.n_nodes + 2,
                       raftlog_handler, g_rl.n_writes};
+    case 7: {  // paxos: max_emits = max(A+1, P+1, 3)
+      int32_t k = g_px.n_acceptors + 1;
+      if (k < g_px.n_proposers + 1) k = g_px.n_proposers + 1;
+      if (k < 3) k = 3;
+      return Workload{g_px.n_acceptors + g_px.n_proposers, 10, 8, k,
+                      paxos_handler};
+    }
     default:
       return Workload{0, 0, 0, 0, nullptr};
   }
@@ -1333,6 +1494,15 @@ int32_t oracle_set_raftlog(int32_t n_nodes, int32_t n_writes, int64_t tmin,
   if (n_writes > kMaxPay) return 1;  // payload arena cap
   g_rl = {n_nodes, n_writes, tmin, tmax, propose_ns, retx_ns, chaos};
   return 0;
+}
+void oracle_set_paxos(int32_t n_acceptors, int32_t n_proposers,
+                      int64_t start_min_ns, int64_t start_max_ns,
+                      int64_t timeout_min_ns, int64_t timeout_max_ns,
+                      int32_t chaos, int64_t kill_min_ns, int64_t kill_max_ns,
+                      int64_t revive_min_ns, int64_t revive_max_ns) {
+  g_px = {n_acceptors,    n_proposers,  start_min_ns, start_max_ns,
+          timeout_min_ns, timeout_max_ns, chaos,      kill_min_ns,
+          kill_max_ns,    revive_min_ns, revive_max_ns};
 }
 
 // Initial node-state rows (Workload.initial_state()), flattened (N*U).

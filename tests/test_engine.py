@@ -626,6 +626,38 @@ def test_twophase_atomicity_under_chaos():
     )
 
 
+def test_paxos_agreement_under_chaos():
+    """Single-decree paxos safety across 1,024 chaos schedules: every
+    seed decides (liveness within the cap), all deciders agree on ONE
+    value (agreement), that value is some proposer's (validity), and a
+    majority of acceptors hold it at halt (the choosing-quorum witness:
+    once chosen, later ballots can only carry the chosen value)."""
+    from madsim_tpu.engine import make_run_while
+    from madsim_tpu.models import make_paxos
+    from madsim_tpu.models.paxos import P_DEC, A_VAL
+
+    a, p = 5, 3
+    wl = make_paxos()
+    cfg = EngineConfig(pool_size=64, loss_p=0.02)
+    out = jax.jit(make_run_while(wl, cfg, 2000))(
+        make_init(wl, cfg)(np.arange(1024, dtype=np.uint64))
+    )
+    h = np.asarray(out.halted)
+    assert h.all(), "every schedule must decide within the cap"
+    assert int(np.asarray(out.overflow).sum()) == 0
+    ns = np.asarray(out.node_state)
+    dec = ns[:, a:, P_DEC]
+    acc_val = ns[:, :a, A_VAL]
+    for s in range(ns.shape[0]):
+        d = dec[s][dec[s] != 0]
+        assert d.size > 0, f"seed {s}: halted without a decision"
+        assert (d == d[0]).all(), f"seed {s}: agreement violated {dec[s]}"
+        assert 1 <= d[0] <= p, f"seed {s}: invalid value {d[0]}"
+        assert (acc_val[s] == d[0]).sum() >= a // 2 + 1, (
+            f"seed {s}: no acceptor-majority witness for {d[0]}"
+        )
+
+
 class TestRaftLog:
     """Raft log replication: safety invariant + lowering equivalence."""
 

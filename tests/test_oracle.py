@@ -24,6 +24,7 @@ from madsim_tpu.models import (
     make_microbench,
     make_pingpong,
     make_raft,
+    make_paxos,
     make_raftlog,
     make_twophase,
 )
@@ -172,3 +173,21 @@ def test_raftlog_no_chaos_bit_identical():
     wl = make_raftlog(chaos=False, n_writes=3)
     cfg = EngineConfig(pool_size=64, loss_p=0.05)
     compare(wl, cfg, list(range(8)), 2000, chaos=False, n_writes=3)
+
+
+@pytest.mark.parametrize("layout", ["dense", "scatter"])
+def test_paxos_traces_bit_identical(layout):
+    # single-decree paxos + proposer crash — the eighth oracle-verified
+    # protocol family (dueling proposers, NACK fast-forward)
+    wl = make_paxos()
+    cfg = EngineConfig(pool_size=64, loss_p=0.02)
+    compare(wl, cfg, list(range(12)), 400, layout=layout)
+
+
+def test_paxos_no_chaos_bit_identical():
+    wl = make_paxos(chaos=False, n_acceptors=3, n_proposers=2)
+    cfg = EngineConfig(pool_size=64, loss_p=0.05)
+    compare(
+        wl, cfg, list(range(8)), 400,
+        chaos=False, n_acceptors=3, n_proposers=2,
+    )

@@ -51,7 +51,8 @@ fi
 # artifact really ran on the accelerator — a mid-chain wedge silently
 # degrades jax to CPU, and banking that would spend the TPU window on
 # numbers the CPU fallback already provides
-if [ -f "${MARK}.sweep.done" ] && [ -f "SWEEP_TPU_${STAMP}.jsonl" ]; then
+if [ -f "${MARK}.sweep.done" ] && [ -f "SWEEP_TPU_${STAMP}.jsonl" ] \
+    && ! grep -q '"platform": "cpu"' SCALING_SWEEP.json; then
   echo "$(date -u +%H:%M:%S) chain: sweep already banked, skipping" >&2
 else
   echo "$(date -u +%H:%M:%S) chain: scaling sweep" >&2
@@ -66,7 +67,8 @@ else
   fi
 fi
 
-if [ -f "${MARK}.profile.done" ] && [ -f "PROFILE_TPU_${STAMP}.jsonl" ]; then
+if [ -f "${MARK}.profile.done" ] && [ -f "PROFILE_TPU_${STAMP}.jsonl" ] \
+    && head -1 "PROFILE_TPU_${STAMP}.jsonl" | grep -vq '"platform": "cpu"'; then
   echo "$(date -u +%H:%M:%S) chain: profile already banked, skipping" >&2
 else
   echo "$(date -u +%H:%M:%S) chain: step ablation profile" >&2

@@ -412,6 +412,14 @@ class Workload:
     # optional human names for the user handlers (len == len(handlers)),
     # used only by engine.replay timelines — no effect on execution
     handler_names: tuple | None = None
+    # state-row columns that survive kill/restart — the batched analog
+    # of FsSim's power-fail semantics (fs.rs:51: disk contents survive
+    # a crash, RAM doesn't). RESTART restores the workload's initial
+    # rows for every column NOT listed here; listed columns keep their
+    # pre-kill values. None = everything volatile (pure-RAM nodes, the
+    # default and the previous behavior). Applies to every node — pick
+    # column meanings so "disk" columns line up across roles.
+    durable_cols: tuple | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -431,11 +439,25 @@ class Workload:
                 f"max_emits={self.max_emits} exceeds the purpose-namespace "
                 f"limit of {limit} (engine/rng.py purpose layout)"
             )
+        if self.durable_cols is not None:
+            bad = [c for c in self.durable_cols if not 0 <= c < self.state_width]
+            if bad:
+                raise ValueError(
+                    f"durable_cols {bad} out of range for "
+                    f"state_width={self.state_width}"
+                )
 
     def initial_state(self) -> np.ndarray:
         if self.init_state is not None:
             return np.asarray(self.init_state, np.int32)
         return np.zeros((self.n_nodes, self.state_width), np.int32)
+
+    def volatile_mask(self) -> np.ndarray:
+        """(U,) bool — True where RESTART resets to the initial row."""
+        mask = np.ones((self.state_width,), bool)
+        if self.durable_cols:
+            mask[list(self.durable_cols)] = False
+        return mask
 
 
 @jax.tree_util.register_dataclass
@@ -640,6 +662,9 @@ def make_step(
     w = wl.payload_words
     aw = wl.args_words
     init_rows = jnp.asarray(wl.initial_state())
+    # durable columns survive kill/restart (FsSim power-fail analog);
+    # static per workload, so the select compiles to a constant mask
+    volatile = jnp.asarray(wl.volatile_mask())
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
     if layout is None:
@@ -884,7 +909,9 @@ def make_step(
         paused = jnp.where(is_killed | is_restarted, False, paused)
         # epoch bumps invalidate every in-flight event targeting the node
         epoch = st.epoch + is_killed + is_restarted
-        node_state = jnp.where(is_restarted[:, None], init_rows, node_state)
+        node_state = jnp.where(
+            is_restarted[:, None] & volatile[None, :], init_rows, node_state
+        )
 
         is_clog_kind = (kind >= KIND_CLOG) & (kind <= KIND_UNCLOG_NODE)
         clog_on = (kind == KIND_CLOG) | (kind == KIND_CLOG_NODE)

@@ -151,6 +151,9 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int64(model_kwargs.get("kill_max_ns", 150_000_000)),
             ctypes.c_int64(model_kwargs.get("revive_min_ns", 80_000_000)),
             ctypes.c_int64(model_kwargs.get("revive_max_ns", 300_000_000)),
+            ctypes.c_int32(
+                1 if model_kwargs.get("durable_acceptors", False) else 0
+            ),
         )
     else:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")
@@ -168,6 +171,13 @@ def run_oracle(
     lib.oracle_set_init_state(
         init_rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         ctypes.c_int64(init_rows.size),
+    )
+    # durable (restart-surviving) columns — always pushed, so a prior
+    # run's setting can't leak into a workload without any
+    dur = np.asarray(sorted(wl.durable_cols or ()), dtype=np.int32)
+    lib.oracle_set_durable_cols(
+        dur.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)) if dur.size else None,
+        ctypes.c_int64(dur.size),
     )
     now = ctypes.c_int64()
     trace = ctypes.c_uint64()

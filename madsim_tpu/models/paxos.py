@@ -83,8 +83,21 @@ def make_paxos(
     kill_max_ns: int = 150_000_000,
     revive_min_ns: int = 80_000_000,
     revive_max_ns: int = 300_000_000,
+    durable_acceptors: bool = False,
 ) -> Workload:
+    """``durable_acceptors=True`` gives every node durable columns 0-2
+    (``Workload.durable_cols`` — the FsSim power-fail analog) and aims
+    the chaos kill at an ACCEPTOR (from ``1..A-1``; acceptor 0 is the
+    halt witness) instead of a proposer: classic paxos with real
+    stable storage, where an acceptor crash loses its RAM and in-flight
+    messages but its (promised, accepted) disk survives — the exact
+    condition single-decree safety requires."""
     a, p = n_acceptors, n_proposers
+    if durable_acceptors and a < 2:
+        raise ValueError(
+            "durable_acceptors needs n_acceptors >= 2: the kill target is "
+            "drawn from acceptors 1..A-1 (acceptor 0 is the halt witness)"
+        )
     n = a + p
     majority = a // 2 + 1
     acceptors = list(range(a))
@@ -107,12 +120,17 @@ def make_paxos(
         _arm(ctx, eb, jnp.int32(1), is_prop, start_min_ns, start_max_ns, _P_START)
         if chaos:
             # acceptor 0's t=0 init schedules the seed's chaos plan: one
-            # PROPOSER killed and later restarted (acceptors are stable
-            # storage — see module docstring)
+            # PROPOSER killed and later restarted — or, with durable
+            # acceptor storage, one ACCEPTOR (see factory docstring)
             first = (ctx.node == jnp.int32(0)) & (ctx.now == 0)
-            who = jnp.int32(a) + ctx.draw.user_int(0, p, _P_KILL_WHO).astype(
-                jnp.int32
-            )
+            if durable_acceptors:
+                who = jnp.int32(1) + ctx.draw.user_int(
+                    0, a - 1, _P_KILL_WHO
+                ).astype(jnp.int32)
+            else:
+                who = jnp.int32(a) + ctx.draw.user_int(
+                    0, p, _P_KILL_WHO
+                ).astype(jnp.int32)
             at = ctx.draw.user_int(kill_min_ns, kill_max_ns, _P_KILL_AT)
             revive = ctx.draw.user_int(revive_min_ns, revive_max_ns, _P_REVIVE)
             eb.after(at, KIND_KILL, 0, (who,), when=first)
@@ -122,11 +140,13 @@ def make_paxos(
 
     def on_propose(ctx):
         st = ctx.state
-        fire = (
-            (ctx.args[0] == st[P_TSEQ])
-            & (st[P_DEC] == jnp.int32(0))
-            & _is_prop(ctx.node)
-        )
+        live = (ctx.args[0] == st[P_TSEQ]) & _is_prop(ctx.node)
+        fire = live & (st[P_DEC] == jnp.int32(0))
+        # decided proposers keep the timer chain alive to re-deliver
+        # DECIDED to the halt witness (acceptor 0) — the one message
+        # with no other retry path; a lost copy would otherwise strand
+        # a fully-decided system un-halted
+        redeliver = live & (st[P_DEC] != jnp.int32(0))
         ballot = st[P_ROUND] * jnp.int32(p) + _pidx(ctx.node) + jnp.int32(1)
         new = jnp.where(
             fire,
@@ -138,15 +158,16 @@ def make_paxos(
             .at[P_ACNT].set(0)
             .at[P_ROUND].set(st[P_ROUND] + 1)
             .at[P_TSEQ].set(st[P_TSEQ] + 1),
-            st,
+            jnp.where(redeliver, st.at[P_TSEQ].set(st[P_TSEQ] + 1), st),
         )
         eb = ctx.emits()
+        eb.send(0, user_kind(_H_DECIDED), (st[P_DEC],), when=redeliver)
         for acc in acceptors:
             eb.send(acc, user_kind(_H_PREPARE), (ballot,), when=fire)
         # the retry chain: a fresh timer per attempt, tseq-guarded so
         # only the latest fires (stale timers are no-ops)
         _arm(
-            ctx, eb, st[P_TSEQ] + 1, fire,
+            ctx, eb, st[P_TSEQ] + 1, fire | redeliver,
             timeout_min_ns, timeout_max_ns, _P_TIMEOUT,
         )
         return new, eb.build()
@@ -268,10 +289,13 @@ def make_paxos(
             on_init, on_propose, on_prepare, on_promise, on_accept,
             on_accepted, on_decided, on_nack,
         ),
-        # widest: on_propose (A prepares + 1 timer); on_accepted sends
-        # P-1 + 1 DECIDEDs; on_init arms 1 timer + 2 chaos events
-        max_emits=max(a + 1, p + 1, 3),
+        # widest: on_propose (1 DECIDED redelivery + A prepares + 1
+        # timer); on_accepted sends P-1 + 1 DECIDEDs; on_init arms 1
+        # timer + 2 chaos events
+        max_emits=max(a + 2, p + 1, 3),
         # largest timer: the chaos restart at 'at + revive'
         delay_bound_ns=max(timeout_max_ns, kill_max_ns + revive_max_ns),
         args_words=3,
+        # acceptor stable storage (promised, accepted_bal, accepted_val)
+        durable_cols=(A_PROM, A_BAL, A_VAL) if durable_acceptors else None,
     )

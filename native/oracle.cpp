@@ -203,6 +203,7 @@ struct Sim {
   std::vector<int32_t> epoch;
   std::vector<int32_t> node_state;  // (N,U)
   std::vector<int32_t> init_state;  // (N,U) Workload.initial_state() rows
+  std::vector<uint8_t> durable;     // (U) restart-surviving columns
   std::vector<uint8_t> clog;        // (N,N)
 
   void init() {
@@ -349,10 +350,14 @@ struct Sim {
       alive[restart_id] = 1;
       epoch[restart_id] += 1;
       // the reborn node restarts from the workload's initial rows, not
-      // zeros (engine: node_state reset to init_rows on restart)
-      for (int32_t u = 0; u < wl.state_width; u++)
+      // zeros (engine: node_state reset to init_rows on restart) —
+      // EXCEPT durable columns, which survive the crash (the FsSim
+      // power-fail analog, Workload.durable_cols)
+      for (int32_t u = 0; u < wl.state_width; u++) {
+        if (u < static_cast<int32_t>(durable.size()) && durable[u]) continue;
         node_state[static_cast<size_t>(restart_id) * wl.state_width + u] =
             init_state[static_cast<size_t>(restart_id) * wl.state_width + u];
+      }
     }
     int32_t pause_id = dispatch ? eff.pause_node : -1;
     if (pause_id >= 0 && pause_id < wl.n_nodes)
@@ -1275,9 +1280,12 @@ struct PaxosParams {
   int64_t start_min_ns, start_max_ns, timeout_min_ns, timeout_max_ns;
   int32_t chaos;
   int64_t kill_min_ns, kill_max_ns, revive_min_ns, revive_max_ns;
+  // kill an acceptor (1..A-1) instead of a proposer; pairs with the
+  // durable acceptor columns (Workload.durable_cols = promised/bal/val)
+  int32_t durable_acceptors;
 };
 PaxosParams g_px{5, 3, 5000000, 30000000, 60000000, 120000000,
-                 1, 30000000, 150000000, 80000000, 300000000};
+                 1, 30000000, 150000000, 80000000, 300000000, 0};
 
 void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   const int32_t K_PROPOSE = FIRST_USER_KIND + 1,
@@ -1303,7 +1311,9 @@ void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
       eff->emits.push_back(mk_after(d, K_PROPOSE, ctx.node, 1, is_prop));
       if (g_px.chaos) {
         bool first = ctx.node == 0 && ctx.now == 0;
-        int64_t who = A + ctx.draw.user_int(0, P, P_KILL_WHO);
+        int64_t who = g_px.durable_acceptors
+                          ? 1 + ctx.draw.user_int(0, A - 1, P_KILL_WHO)
+                          : A + ctx.draw.user_int(0, P, P_KILL_WHO);
         int64_t at =
             ctx.draw.user_int(g_px.kill_min_ns, g_px.kill_max_ns, P_KILL_AT);
         int64_t revive = ctx.draw.user_int(g_px.revive_min_ns,
@@ -1317,7 +1327,11 @@ void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
       break;
     }
     case 1: {  // on_propose (timer at proposer)
-      bool fire = ctx.args[0] == st[S_TSEQ] && st[S_DEC] == 0 && is_prop;
+      bool live = ctx.args[0] == st[S_TSEQ] && is_prop;
+      bool fire = live && st[S_DEC] == 0;
+      // decided proposers keep re-delivering DECIDED to the halt
+      // witness (engine on_propose `redeliver`)
+      bool redeliver = live && st[S_DEC] != 0;
       int32_t pidx = ctx.node - A;
       int32_t ballot = st[S_ROUND] * P + pidx + 1;
       if (fire) {
@@ -1329,13 +1343,16 @@ void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
         ns[S_ACNT] = 0;
         ns[S_ROUND] = st[S_ROUND] + 1;
         ns[S_TSEQ] = st[S_TSEQ] + 1;
+      } else if (redeliver) {
+        ns[S_TSEQ] = st[S_TSEQ] + 1;
       }
+      eff->emits.push_back(mk_send(0, K_DECIDED, st[S_DEC], 0, redeliver));
       for (int32_t acc = 0; acc < A; acc++)
         eff->emits.push_back(mk_send(acc, K_PREPARE, ballot, 0, fire));
       int64_t d = ctx.draw.user_int(g_px.timeout_min_ns, g_px.timeout_max_ns,
                                     P_TIMEOUT);
       eff->emits.push_back(
-          mk_after(d, K_PROPOSE, ctx.node, st[S_TSEQ] + 1, fire));
+          mk_after(d, K_PROPOSE, ctx.node, st[S_TSEQ] + 1, fire || redeliver));
       break;
     }
     case 2: {  // on_prepare (at acceptor)
@@ -1448,8 +1465,8 @@ Workload make_workload(int32_t id) {
     case 6:  // raftlog: max_emits = N + 2 (grant: N appends + 2 timers)
       return Workload{g_rl.n_nodes, 8 + g_rl.n_writes, 8, g_rl.n_nodes + 2,
                       raftlog_handler, g_rl.n_writes};
-    case 7: {  // paxos: max_emits = max(A+1, P+1, 3)
-      int32_t k = g_px.n_acceptors + 1;
+    case 7: {  // paxos: max_emits = max(A+2, P+1, 3)
+      int32_t k = g_px.n_acceptors + 2;
       if (k < g_px.n_proposers + 1) k = g_px.n_proposers + 1;
       if (k < 3) k = 3;
       return Workload{g_px.n_acceptors + g_px.n_proposers, 10, 8, k,
@@ -1499,16 +1516,26 @@ void oracle_set_paxos(int32_t n_acceptors, int32_t n_proposers,
                       int64_t start_min_ns, int64_t start_max_ns,
                       int64_t timeout_min_ns, int64_t timeout_max_ns,
                       int32_t chaos, int64_t kill_min_ns, int64_t kill_max_ns,
-                      int64_t revive_min_ns, int64_t revive_max_ns) {
-  g_px = {n_acceptors,    n_proposers,  start_min_ns, start_max_ns,
-          timeout_min_ns, timeout_max_ns, chaos,      kill_min_ns,
-          kill_max_ns,    revive_min_ns, revive_max_ns};
+                      int64_t revive_min_ns, int64_t revive_max_ns,
+                      int32_t durable_acceptors) {
+  g_px = {n_acceptors,    n_proposers,    start_min_ns, start_max_ns,
+          timeout_min_ns, timeout_max_ns, chaos,        kill_min_ns,
+          kill_max_ns,    revive_min_ns,  revive_max_ns, durable_acceptors};
 }
 
 // Initial node-state rows (Workload.initial_state()), flattened (N*U).
 // Passed per run by the Python bridge so nonzero init_state workloads
 // stay bit-identical (init AND restart both restore these rows).
 std::vector<int32_t> g_init_state;
+
+// Durable (restart-surviving) state columns, as indices; cleared or
+// replaced per run by the Python bridge (Workload.durable_cols).
+std::vector<int32_t> g_durable_cols;
+void oracle_set_durable_cols(const int32_t* cols, int64_t n) {
+  g_durable_cols.clear();
+  if (cols != nullptr && n > 0) g_durable_cols.assign(cols, cols + n);
+}
+
 void oracle_set_init_state(const int32_t* rows, int64_t n) {
   if (rows == nullptr || n <= 0) {
     g_init_state.clear();
@@ -1540,6 +1567,9 @@ int32_t oracle_run(int32_t workload_id, uint64_t seed, int64_t n_steps,
       static_cast<int64_t>(wl.n_nodes) * wl.state_width) {
     sim.init_state = g_init_state;
   }
+  sim.durable.assign(wl.state_width, 0);
+  for (int32_t c : g_durable_cols)
+    if (c >= 0 && c < wl.state_width) sim.durable[c] = 1;
   sim.init();
   for (int64_t s = 0; s < n_steps; s++) sim.do_step();
   *out_now = sim.now;

@@ -191,3 +191,11 @@ def test_paxos_no_chaos_bit_identical():
         wl, cfg, list(range(8)), 400,
         chaos=False, n_acceptors=3, n_proposers=2,
     )
+
+
+def test_paxos_durable_acceptors_bit_identical():
+    # acceptor kills with durable (promised, accepted) columns — the
+    # Workload.durable_cols restart path, mirrored in the oracle
+    wl = make_paxos(durable_acceptors=True)
+    cfg = EngineConfig(pool_size=64, loss_p=0.02)
+    compare(wl, cfg, list(range(10)), 400, durable_acceptors=True)

@@ -1,0 +1,87 @@
+"""Head-to-head: VMEM-resident pallas runner vs the XLA-scheduled loop.
+
+Times `engine.vmem.make_run_vmem` against `make_run` (same lockstep
+step count, same seeds) on the current backend and prints one JSON
+line per configuration plus a verdict line. Run on TPU via
+tools/tpu_chain.sh (last step); on CPU the kernel interprets, so the
+numbers only validate plumbing, not performance.
+
+Usage: python examples/vmem_probe.py [n_seeds] [n_steps] [block_seeds]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+from madsim_tpu.engine import EngineConfig, SimState, make_init, make_run
+from madsim_tpu.engine.vmem import make_run_vmem
+from madsim_tpu.models import BENCH_SPECS
+
+N_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+N_STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+BLOCK = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+REPEATS = 3
+
+
+def timed(tag, fn, state):
+    jax.block_until_ready(fn(state))  # compile
+    walls = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(state))
+        walls.append(time.perf_counter() - t0)
+    wall = float(np.median(walls))
+    rec = {
+        "variant": tag,
+        "wall_s_median": round(wall, 4),
+        "walls_s": [round(w, 4) for w in walls],
+        "ns_per_seed_step": round(wall / N_SEEDS / N_STEPS * 1e9, 3),
+    }
+    print(json.dumps(rec), flush=True)
+    return out, wall
+
+
+def main():
+    mk, cfg_kw, _, _ = BENCH_SPECS["raft"]
+    wl, cfg = mk(), EngineConfig(**cfg_kw)
+    platform = jax.devices()[0].platform
+    print(json.dumps({
+        "platform": platform, "n_seeds": N_SEEDS, "n_steps": N_STEPS,
+        "block_seeds": BLOCK,
+    }), flush=True)
+    st = make_init(wl, cfg)(np.arange(N_SEEDS, dtype=np.uint64))
+
+    plain_out, plain_wall = timed("xla_loop", jax.jit(make_run(wl, cfg, N_STEPS)), st)
+    vmem_out, vmem_wall = timed(
+        "vmem_kernel",
+        jax.jit(make_run_vmem(wl, cfg, N_STEPS, block_seeds=BLOCK)),
+        st,
+    )
+
+    identical = all(
+        np.array_equal(
+            np.asarray(getattr(plain_out, f.name)),
+            np.asarray(getattr(vmem_out, f.name)),
+        )
+        for f in dataclasses.fields(SimState)
+    )
+    print(json.dumps({
+        "verdict": {
+            "identical": identical,
+            "speedup_vmem_over_xla": round(plain_wall / vmem_wall, 3),
+            "platform": platform,
+        }
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -661,10 +661,13 @@ def make_step(
     k = wl.max_emits
     w = wl.payload_words
     aw = wl.args_words
-    init_rows = jnp.asarray(wl.initial_state())
+    # numpy (not jnp) so they embed as literals: a jnp closure constant
+    # would block wrapping the step in a pallas kernel (pallas requires
+    # traced constants to be declared inputs)
+    init_rows = wl.initial_state()
     # durable columns survive kill/restart (FsSim power-fail analog);
     # static per workload, so the select compiles to a constant mask
-    volatile = jnp.asarray(wl.volatile_mask())
+    volatile = wl.volatile_mask()
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
     if layout is None:
@@ -711,7 +714,13 @@ def make_step(
     loss_u32 = cfg.loss_u32
     time_limit = np.int64(cfg.time_limit_ns) if cfg.time_limit_ns else _INF_NS
 
-    def step(st: SimState) -> SimState:
+    def step(st: SimState, _tables=None) -> SimState:
+        # ``_tables``: optional (init_rows, volatile) arrays overriding
+        # the embedded literals — the pallas seam: a kernel cannot
+        # capture non-scalar jaxpr constants, so kernel wrappers thread
+        # the two tables through as kernel inputs (engine/vmem.py).
+        # Values are identical either way.
+        ir, vo = (init_rows, volatile) if _tables is None else _tables
         # representation guard (trace-time): a state built or restored
         # under the other time representation would be silently
         # misread — e.g. a checkpoint saved where time32 auto-resolved
@@ -910,7 +919,7 @@ def make_step(
         # epoch bumps invalidate every in-flight event targeting the node
         epoch = st.epoch + is_killed + is_restarted
         node_state = jnp.where(
-            is_restarted[:, None] & volatile[None, :], init_rows, node_state
+            is_restarted[:, None] & vo[None, :], ir, node_state
         )
 
         is_clog_kind = (kind >= KIND_CLOG) & (kind <= KIND_UNCLOG_NODE)

@@ -1,0 +1,133 @@
+"""VMEM-resident runner: the whole simulation loop inside one kernel.
+
+The plain runners (`make_run`/`make_run_while`) let XLA schedule each
+step over HBM-resident state. This module wraps the SAME step function
+in a Pallas kernel that grids over seed blocks and runs the full step
+loop per block with all state living in VMEM: HBM traffic per block
+drops from per-step round trips to one load plus one store, and every
+step op reads on-chip memory. Values are bit-identical to the plain
+runner — the kernel body IS `make_step` (tests/test_vmem.py asserts
+equality per field).
+
+This is the exploratory "fused kernel" lever from the perf plan
+(SCALING.md §3): whether it beats the XLA-scheduled loop on real
+silicon depends on whether the step is compute- or traffic-bound
+there — `examples/vmem_probe.py` measures the head-to-head. On CPU
+the kernel runs in interpreter mode (for tests); it is NOT the
+default path anywhere.
+
+Constraints: the per-block state must fit VMEM (~16 MB/core —
+`block_seeds` trades grid size against residency; raft at time32 is
+roughly 0.9 KB/seed, so 2,048-seed blocks use ~2 MB plus
+double-buffering headroom), and the loop is lockstep `fori_loop` (no
+early exit; halted seeds already freeze inside the step, and the
+compacted runner remains the tail-economics answer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .core import EngineConfig, SimState, Workload, make_step
+
+__all__ = ["make_run_vmem"]
+
+
+def make_run_vmem(
+    wl: Workload,
+    cfg: EngineConfig,
+    n_steps: int,
+    block_seeds: int = 2048,
+    layout: str | None = "dense",
+    time32: bool | None = None,
+    interpret: bool | None = None,
+):
+    """Build ``run(state) -> SimState``: ``n_steps`` of the engine step
+    with each seed-block's state VMEM-resident for the whole loop.
+
+    ``interpret`` None = interpreter mode on the CPU backend (tests),
+    compiled Mosaic elsewhere. The seed count must be a multiple of
+    ``block_seeds``.
+    """
+    step1 = make_step(wl, cfg, layout, time32)
+    vstep = jax.vmap(step1, in_axes=(0, None))
+    fields = [f.name for f in dataclasses.fields(SimState)]
+    # the two tables make_step otherwise embeds as constants — a pallas
+    # kernel cannot capture non-scalar jaxpr constants, so they ride as
+    # explicit kernel inputs (the `_tables` seam in make_step)
+    tables = (
+        jnp.asarray(wl.initial_state()),
+        jnp.asarray(wl.volatile_mask()),
+    )
+
+    def build(state: SimState):
+        s0 = int(state.seed.shape[0])
+        if s0 % block_seeds:
+            raise ValueError(
+                f"{s0} seeds do not split into {block_seeds}-seed blocks"
+            )
+        b = block_seeds
+        vals = {f: getattr(state, f) for f in fields}
+        # zero-size fields (e.g. ev_pay at payload_words=0) break pallas
+        # block padding; they carry no data, so they are rebuilt inside
+        # the kernel instead of passed through
+        live = [f for f in fields if int(np.prod(vals[f].shape)) > 0]
+        zero = {
+            f: (vals[f].shape[1:], vals[f].dtype)
+            for f in fields
+            if f not in live
+        }
+
+        def block_spec(arr):
+            shape = (b,) + arr.shape[1:]
+            ndim = len(shape)
+            return pl.BlockSpec(shape, lambda i, _nd=ndim: (i,) + (0,) * (_nd - 1))
+
+        def table_spec(arr):
+            shape = arr.shape
+            ndim = len(shape)
+            return pl.BlockSpec(shape, lambda i, _nd=ndim: (0,) * _nd)
+
+        def kernel(*refs):
+            nf = len(live)
+            in_refs, t_refs, out_refs = refs[:nf], refs[nf : nf + 2], refs[nf + 2 :]
+            d = {f: r[...] for f, r in zip(live, in_refs)}
+            for f, (tail, dt) in zero.items():
+                d[f] = jnp.zeros((b,) + tail, dt)
+            st = SimState(**d)
+            tabs = (t_refs[0][...], t_refs[1][...])
+            final = lax.fori_loop(0, n_steps, lambda i, s: vstep(s, tabs), st)
+            for f, r in zip(live, out_refs):
+                r[...] = getattr(final, f)
+
+        call = pl.pallas_call(
+            kernel,
+            grid=(s0 // b,),
+            in_specs=[block_spec(vals[f]) for f in live]
+            + [table_spec(t) for t in tables],
+            out_specs=[block_spec(vals[f]) for f in live],
+            out_shape=[
+                jax.ShapeDtypeStruct(vals[f].shape, vals[f].dtype) for f in live
+            ],
+            interpret=(jax.default_backend() == "cpu")
+            if interpret is None
+            else interpret,
+        )
+        return call, live, zero, vals
+
+    def run(state: SimState) -> SimState:
+        call, live, zero, vals = build(state)
+        outs = call(*[vals[f] for f in live], *tables)
+        d = dict(zip(live, outs))
+        for f, (tail, dt) in zero.items():
+            d[f] = jnp.zeros((state.seed.shape[0],) + tail, dt)
+        return SimState(**d)
+
+    return run

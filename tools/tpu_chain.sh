@@ -83,5 +83,21 @@ else
   fi
 fi
 
+if [ -f "${MARK}.vmem.done" ] && [ -f "VMEM_TPU_${STAMP}.jsonl" ]; then
+  echo "$(date -u +%H:%M:%S) chain: vmem probe already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: vmem kernel head-to-head" >&2
+  if timeout 900 python examples/vmem_probe.py 65536 64 2048 \
+      > "VMEM_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err \
+      && head -1 "VMEM_TPU_${STAMP}.jsonl" | grep -vq '"platform": "cpu"'; then
+    touch "${MARK}.vmem.done"
+    echo "$(date -u +%H:%M:%S) chain: vmem probe banked" >&2
+  else
+    # exploratory: pallas may not compile on this backend at all —
+    # a failure here doesn't fail the chain
+    echo "$(date -u +%H:%M:%S) chain: vmem probe failed or on CPU (non-fatal)" >&2
+  fi
+fi
+
 echo "$(date -u +%H:%M:%S) chain: done (fail=$fail)" >&2
 exit "$fail"

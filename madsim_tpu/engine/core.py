@@ -446,6 +446,14 @@ class Workload:
                     f"durable_cols {bad} out of range for "
                     f"state_width={self.state_width}"
                 )
+        if self.handler_names is not None and len(self.handler_names) != len(
+            self.handlers
+        ):
+            raise ValueError(
+                f"handler_names has {len(self.handler_names)} entries for "
+                f"{len(self.handlers)} handlers — replay timelines would "
+                f"label the wrong handlers"
+            )
 
     def initial_state(self) -> np.ndarray:
         if self.init_state is not None:

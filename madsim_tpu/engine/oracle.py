@@ -14,11 +14,18 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from dataclasses import dataclass
 
 import numpy as np
 
 from .core import EngineConfig, Workload
+
+# the oracle's parameter registers and optional event-log buffers are
+# process globals (oracle.cpp g_* / g_log_*), so every set_params ->
+# oracle_run window must be serialized process-wide. Reentrant so
+# replay() can hold it across its attach -> run_oracle -> detach span.
+ORACLE_LOCK = threading.RLock()
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE = os.path.join(_REPO, "native")
@@ -164,6 +171,13 @@ def run_oracle(
 ) -> OracleResult:
     """Run one seed through the C++ oracle."""
     lib = load()
+    with ORACLE_LOCK:
+        return _run_locked(lib, wl, cfg, seed, n_steps, **model_kwargs)
+
+
+def _run_locked(
+    lib, wl: Workload, cfg: EngineConfig, seed: int, n_steps: int, **model_kwargs
+) -> OracleResult:
     set_params(lib, wl, **model_kwargs)
     # push the workload's initial rows so nonzero init_state (and the
     # restart-restores-initial-rows path) stays bit-identical

@@ -42,6 +42,7 @@ _ENGINE_KIND_NAMES = {
 }
 
 
+
 @dataclass(frozen=True)
 class ReplayEvent:
     """One dispatched event: the tuple the trace hash folds."""
@@ -96,22 +97,30 @@ def replay(
         src = np.zeros(cap, np.int32)
         args = np.zeros((cap, 4), np.int32)
         pay = np.zeros((cap, 4), np.int32)
-        lib.oracle_set_log(
-            t.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            kind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            node.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            args.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            pay.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            ctypes.c_int64(cap),
-        )
-        try:
-            res = _oracle.run_oracle(wl, cfg, seed, n_steps, **model_kwargs)
-            count = int(lib.oracle_log_count())
-        finally:
-            # detach: the buffers die with this frame, a later un-logged
-            # oracle_run must not write through dangling pointers
-            lib.oracle_set_log(None, None, None, None, None, None, 0)
+        # the log buffers are process-global (oracle.cpp g_log_*): hold
+        # the reentrant oracle lock across the whole attach->run->detach
+        # window so no other oracle_run (with or without logging) can
+        # write through the attached pointers. run_oracle re-enters the
+        # same lock on this thread; other threads block. The attach is
+        # INSIDE the with/try so any failure still detaches + releases.
+        with _oracle.ORACLE_LOCK:
+            try:
+                lib.oracle_set_log(
+                    t.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    kind.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    node.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    args.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    pay.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                    ctypes.c_int64(cap),
+                )
+                res = _oracle.run_oracle(wl, cfg, seed, n_steps, **model_kwargs)
+                count = int(lib.oracle_log_count())
+            finally:
+                # detach: the buffers die with this frame, a later
+                # un-logged oracle_run must not write through dangling
+                # pointers
+                lib.oracle_set_log(None, None, None, None, None, None, 0)
         if count <= cap:
             break
         cap = max(cap * 2, count)

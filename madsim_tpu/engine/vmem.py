@@ -122,8 +122,24 @@ def make_run_vmem(
         )
         return call, live, zero, vals
 
+    # shape-keyed cache: un-jitted callers would otherwise construct a
+    # fresh pallas_call (and retrace the kernel) on every invocation
+    _built: dict = {}
+
     def run(state: SimState) -> SimState:
-        call, live, zero, vals = build(state)
+        key = tuple(
+            (f.name, getattr(state, f.name).shape,
+             str(getattr(state, f.name).dtype))
+            for f in dataclasses.fields(SimState)
+        )
+        if key not in _built:
+            call, live, zero, _vals = build(state)
+            # cache only the program + field split: holding the first
+            # caller's concrete arrays would pin them for the runner's
+            # lifetime
+            _built[key] = (call, live, zero)
+        call, live, zero = _built[key]
+        vals = {f: getattr(state, f) for f in live}
         outs = call(*[vals[f] for f in live], *tables)
         d = dict(zip(live, outs))
         for f, (tail, dt) in zero.items():

@@ -1594,6 +1594,11 @@ void oracle_threefry2x32(uint32_t k0, uint32_t k1, uint32_t x0, uint32_t x1,
 // Attach caller-owned per-dispatch log buffers (engine/replay.py).
 // args is (cap, 4) row-major; pay is (cap, 4 = kMaxPay) row-major.
 // Pass cap=0 (and nulls) to detach. The next oracle_run fills from 0.
+// NOT thread-safe: the g_log_* globals are unguarded, so the
+// attach -> oracle_run -> detach window must be serialized by the
+// caller against EVERY other oracle_run in the process (the Python
+// bridge's reentrant ORACLE_LOCK guards every run_oracle, and
+// replay.py holds the same lock across this window).
 void oracle_set_log(int64_t* t, int32_t* kind, int32_t* node, int32_t* src,
                     int32_t* args, int32_t* pay, int64_t cap) {
   g_log_time = t;

@@ -78,7 +78,18 @@ def make_raftlog(
     propose_ns: int = 20_000_000,
     retx_ns: int = 60_000_000,
     chaos: bool = True,
+    durable: bool = False,
 ) -> Workload:
+    """``durable=True`` persists exactly the columns the raft paper's
+    Figure 2 marks persistent — currentTerm (TERM), votedFor (VOTED,
+    here the voted-in term), and the log (LOGLEN + LOG0..) — across
+    kill/restart via ``Workload.durable_cols`` (the FsSim power-fail
+    analog, fs.rs:51). Role, votes, timer seq, ack mask and COMMIT stay
+    volatile, as specified: a restarted node comes back a follower and
+    re-learns commitIndex from its leader's next AppendEntries. The
+    default ``durable=False`` keeps the historical diskless behavior
+    (restart restores the initial row), which leans on the first
+    retransmission to reinstall the whole log."""
     majority = n_nodes // 2 + 1
     nodes = list(range(n_nodes))
     w = n_writes
@@ -316,5 +327,10 @@ def make_raftlog(
         # restart at 'at + revive' <= 500 + 600 ms
         delay_bound_ns=max(
             timeout_max_ns, propose_ns, retx_ns, 1_100_000_000
+        ),
+        durable_cols=(
+            (TERM, VOTED, LOGLEN) + tuple(LOG0 + j for j in range(w))
+            if durable
+            else None
         ),
     )

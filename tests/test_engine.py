@@ -719,11 +719,11 @@ def test_paxos_durable_acceptor_kills_stay_safe():
 class TestRaftLog:
     """Raft log replication: safety invariant + lowering equivalence."""
 
-    def _final_states(self, n_seeds=1024):
+    def _final_states(self, n_seeds=1024, durable=False):
         from madsim_tpu.engine import EngineConfig, make_init, make_run_while
         from madsim_tpu.models import make_raftlog
 
-        wl = make_raftlog()
+        wl = make_raftlog(durable=durable)
         cfg = EngineConfig(
             pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000
         )
@@ -732,13 +732,9 @@ class TestRaftLog:
         )
         return jax.block_until_ready(out)
 
-    def test_committed_entries_on_majority(self):
-        # the raft safety claim, checked across seeds, elections and the
-        # seeded leader kill/restart: at halt, the committed log is
-        # present in order with equal values on a majority of nodes
+    def _assert_majority_prefix(self, out):
         from madsim_tpu.models.raftlog import COMMIT, LOG0, LOGLEN
 
-        out = self._final_states()
         h = np.asarray(out.halted)
         ns = np.asarray(out.node_state)
         assert h.all(), "every seed must finish its writes"
@@ -759,6 +755,19 @@ class TestRaftLog:
                 and ((rows[i][LOG0:LOG0 + W] & 0xFF) == ref).all()
             )
             assert match >= 3, f"seed {s}: committed log on {match}/5 nodes"
+
+    def test_committed_entries_on_majority(self):
+        # the raft safety claim, checked across seeds, elections and the
+        # seeded leader kill/restart: at halt, the committed log is
+        # present in order with equal values on a majority of nodes
+        self._assert_majority_prefix(self._final_states())
+
+    def test_committed_entries_on_majority_durable(self):
+        # crash-recovery raft: same invariant with the paper's persistent
+        # state (term, votedFor, log) surviving the kill — the restart no
+        # longer wipes the log, so safety must hold through genuine
+        # recovery rather than reinstall-from-leader
+        self._assert_majority_prefix(self._final_states(durable=True))
 
     def test_check_layouts_raftlog(self):
         from madsim_tpu.engine import EngineConfig, check_layouts, time32_eligible

@@ -175,6 +175,16 @@ def test_raftlog_no_chaos_bit_identical():
     compare(wl, cfg, list(range(8)), 2000, chaos=False, n_writes=3)
 
 
+def test_raftlog_durable_bit_identical():
+    # crash-recovery raft: (term, votedFor, log) survive the leader
+    # kill/restart via Workload.durable_cols — the restart path restores
+    # only the volatile columns, mirrored in the oracle (the durable set
+    # is pushed generically by engine/oracle.py, no model flag needed)
+    wl = make_raftlog(durable=True)
+    cfg = EngineConfig(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    compare(wl, cfg, list(range(10)), 3000)
+
+
 @pytest.mark.parametrize("layout", ["dense", "scatter"])
 def test_paxos_traces_bit_identical(layout):
     # single-decree paxos + proposer crash — the eighth oracle-verified

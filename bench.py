@@ -2,7 +2,10 @@
 
 Reports all five BASELINE.md benchmark configs plus raftlog (the raft
 log-replication family, beyond-BASELINE) and prints the headline JSON
-line (raft, the north-star workload) LAST:
+line (raft, the north-star workload) LAST. Every quoted cell is a
+multi-second sized dispatch with reported spread (engine/measure.py) on
+BOTH platforms; the deliberately single-seed pingpong config is quoted
+as latency (wall us per complete sim), not throughput:
 
     {"metric": "sim_seconds_per_sec_per_chip", "value": N,
      "unit": "sim_s/s/chip", "vs_baseline": N / 200000,
@@ -53,9 +56,10 @@ CONFIGS = {
 CPU_ONLY_CONFIGS = {"pingpong"}
 # CPU fallback sizing: seeds are capped by a measured time budget, not a
 # fixed count — a tiny calibration batch estimates per-seed cost and the
-# child picks the largest power-of-two batch fitting CPU_TIME_BUDGET_S,
-# so the fallback artifact still carries scaling information
-CPU_TIME_BUDGET_S = 60.0
+# child picks the largest power-of-two batch whose single-batch wall is
+# ~CPU_CELL_TARGET_S, so the fallback artifact still carries scaling
+# information while every measured dispatch stays multi-second
+CPU_CELL_TARGET_S = 3.0
 CPU_CALIBRATE_SEEDS = 256
 
 
@@ -189,8 +193,10 @@ def parent() -> None:
                 "configs": {
                     k: {
                         "value": v["value"],
+                        "unit": v.get("unit", "sim_s/s/chip"),
                         "n_seeds": v["n_seeds"],
                         "platform": v.get("platform", platform),
+                        "spread_pct": v.get("spread_pct"),
                     }
                     for k, v in results.items()
                 },
@@ -244,63 +250,34 @@ def child(config: str) -> None:
     def _min_size(s: int) -> int:
         return min(2048, max(s // 4, 1))
 
-    # seed compaction (engine/compact.py): halted rows leave the batch in
-    # static shrink-steps, so the straggler tail doesn't bill every seed.
-    # Per-seed values are bit-identical to the lockstep loop
-    # (tests/test_compact.py). Only `run.compute` (device work) is timed
-    # — block on the device arrays inside the window, run the
-    # device->host transfer + reassembly (`run.assemble`) after it —
-    # the same methodology as timing the old lockstep SimState run and
-    # reading .now afterwards.
-    if jax.devices()[0].platform == "cpu" and n_seeds > CPU_CALIBRATE_SEEDS:
-        run = make_run_compacted(
-            wl, cfg, n_steps,
-            min_size=_min_size(CPU_CALIBRATE_SEEDS), fields=("now", "overflow"),
-        )
-        # time-budgeted fallback sizing: measure a small batch, then run
-        # the largest power-of-two batch that fits the budget (per-seed
-        # cost is ~flat above the calibration size, so this estimate is
-        # conservative)
-        jax.block_until_ready(
-            run.compute(init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64)))
-        )  # compile outside the timed window
-        cal = init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64))
-        t0 = time.perf_counter()
-        jax.block_until_ready(run.compute(cal))
-        per_seed = (time.perf_counter() - t0) / CPU_CALIBRATE_SEEDS
-        # the budget covers warm-up + the measured run (2 full passes)
-        fit = int(CPU_TIME_BUDGET_S / 2 / max(per_seed, 1e-9))
-        sized = CPU_CALIBRATE_SEEDS
-        while sized * 2 <= min(fit, n_seeds):
-            sized *= 2
-        n_seeds = sized
+    from madsim_tpu.engine.measure import measure_throughput
 
+    accel = jax.devices()[0].platform != "cpu"
     n_chips = max(jax.device_count(), 1)
-    if jax.devices()[0].platform != "cpu":
-        # accelerator: the remote-tunnel dispatch path has multi-100ms
-        # jitter, so sub-second runs measure the transport, not the
-        # chip. measure_throughput (engine/measure.py) packs repeated
-        # independent seed-batches into ONE >=5s jitted dispatch and
-        # reports the median over 5 dispatches — jitter amortized
-        # structurally, spread reported honestly.
-        from madsim_tpu.engine.measure import measure_throughput
+    # seeds wrap inside the range each pool size was verified
+    # overflow-free for (models.BENCH_SPECS sizing note): raft over
+    # 0..524287, the rest over the sweep's 0..131071
+    seed_mod = 524288 if config == "raft" else 131072
 
-        # seeds wrap inside the range each pool size was verified
-        # overflow-free for (models.BENCH_SPECS sizing note): raft over
-        # 0..524287, the rest over the sweep's 0..131071
-        seed_mod = 524288 if config == "raft" else 131072
-        rec = measure_throughput(
-            wl, cfg, n_steps, n_seeds, target_wall_s=5.0, n_measure=5,
-            seed_mod=seed_mod, min_size=_min_size(n_seeds),
-        )
-        # the small pool sizes are only valid while nothing overflows; a
-        # silent drop would skew the metric. Reported as a distinct
-        # JSON error (exit 0) so the parent records a config failure
-        # instead of misreading rc!=0 as a wedge and degrading to CPU.
-        if rec["overflow"]:
+    if config == "pingpong":
+        # BASELINE config 1 is a deliberately single-seed sim — one seed
+        # cannot amortize dispatch overhead, so a throughput quote would
+        # measure the transport. Quote it as LATENCY (engine/measure.py
+        # measure_latency: repeats independent single-seed sims packed
+        # into multi-second dispatches, median wall-per-sim).
+        from madsim_tpu.engine.measure import measure_latency
+
+        rec = measure_latency(wl, cfg, n_steps, seed_mod=seed_mod)
+        if rec["overflow"] or not rec["all_halted"]:
             print(
                 json.dumps(
-                    {"config": config, "error": "pool_overflow", "drops": rec["overflow"]}
+                    {
+                        "config": config,
+                        "error": "pool_overflow"
+                        if rec["overflow"]
+                        else "not_all_halted",
+                        "drops": rec["overflow"],
+                    }
                 )
             )
             return
@@ -308,56 +285,79 @@ def child(config: str) -> None:
             json.dumps(
                 {
                     "config": config,
-                    "metric": "sim_seconds_per_sec_per_chip",
-                    "value": round(rec["sim_s_per_s_median"] / n_chips, 2),
-                    "unit": "sim_s/s/chip",
+                    "metric": "wall_us_per_sim",
+                    "value": rec["wall_us_per_sim_median"],
+                    "unit": "us/sim",
                     "platform": jax.devices()[0].platform,
-                    "n_seeds": n_seeds,
+                    "n_seeds": 1,
                     "repeats_per_dispatch": rec["repeats"],
                     "dispatch_walls_s": rec["dispatch_walls_s"],
                     "spread_pct": rec["spread_pct"],
-                    "all_halted": rec["all_halted"],
+                    "sim_s_per_s": rec["sim_s_per_s"],
                 }
             )
         )
         return
 
-    # (re)build the runner at the final seed count's min_size — the CPU
-    # sizing above may have shrunk n_seeds, and the small-batch path
-    # never built one
-    run = make_run_compacted(
-        wl, cfg, n_steps, min_size=_min_size(n_seeds), fields=("now", "overflow")
+    if not accel and n_seeds > CPU_CALIBRATE_SEEDS:
+        # CPU fallback sizing: estimate per-seed cost on a small batch,
+        # then pick the largest power-of-two batch whose single-batch
+        # wall is ~CPU_CELL_TARGET_S (capped at the spec seed count) —
+        # measure_throughput then packs repeats if the batch is shorter
+        run = make_run_compacted(
+            wl, cfg, n_steps,
+            min_size=_min_size(CPU_CALIBRATE_SEEDS), fields=("now",),
+        )
+        jax.block_until_ready(
+            run.compute(init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64)))
+        )  # compile outside the timed window
+        cal = init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64))
+        t0 = time.perf_counter()
+        jax.block_until_ready(run.compute(cal))
+        per_seed = (time.perf_counter() - t0) / CPU_CALIBRATE_SEEDS
+        fit = int(CPU_CELL_TARGET_S / max(per_seed, 1e-9))
+        sized = CPU_CALIBRATE_SEEDS
+        while sized * 2 <= min(fit, n_seeds):
+            sized *= 2
+        n_seeds = sized
+
+    # Both platforms: jitter-proof sized dispatches (engine/measure.py).
+    # The TPU tunnel has multi-100ms dispatch jitter; the CPU has none
+    # but multi-second cells with reported spread cost little and keep
+    # the artifact schema identical across platforms. Each dispatch
+    # packs `repeats` independent seed-batches into one jitted
+    # fori_loop >= target_wall_s long; the quoted rate is the median
+    # over n_measure dispatches.
+    rec = measure_throughput(
+        wl, cfg, n_steps, n_seeds,
+        target_wall_s=5.0 if accel else 3.5,
+        n_measure=5 if accel else 3,
+        seed_mod=seed_mod, min_size=_min_size(n_seeds),
     )
-    state = init(np.arange(n_seeds, dtype=np.uint64))
-    jax.block_until_ready(run.compute(state))  # warm-up compile
-
-    # CPU has no dispatch jitter: one measured run
-    state = init(np.arange(n_seeds, 2 * n_seeds, dtype=np.uint64))
-    t0 = time.perf_counter()
-    banked = jax.block_until_ready(run.compute(state))
-    wall = time.perf_counter() - t0
-    out = run.assemble(banked)
-
-    sim_seconds = float(np.asarray(out.now, dtype=np.float64).sum() / 1e9)
-    overflow = int(np.asarray(out.overflow).sum())
-    if overflow:
+    # the small pool sizes are only valid while nothing overflows; a
+    # silent drop would skew the metric. Reported as a distinct
+    # JSON error (exit 0) so the parent records a config failure
+    # instead of misreading rc!=0 as a wedge and degrading to CPU.
+    if rec["overflow"]:
         print(
             json.dumps(
-                {"config": config, "error": "pool_overflow", "drops": overflow}
+                {"config": config, "error": "pool_overflow", "drops": rec["overflow"]}
             )
         )
         return
-    value = sim_seconds / wall / n_chips
     print(
         json.dumps(
             {
                 "config": config,
                 "metric": "sim_seconds_per_sec_per_chip",
-                "value": round(value, 2),
+                "value": round(rec["sim_s_per_s_median"] / n_chips, 2),
                 "unit": "sim_s/s/chip",
                 "platform": jax.devices()[0].platform,
                 "n_seeds": n_seeds,
-                "wall_s": round(wall, 3),
+                "repeats_per_dispatch": rec["repeats"],
+                "dispatch_walls_s": rec["dispatch_walls_s"],
+                "spread_pct": rec["spread_pct"],
+                "all_halted": rec["all_halted"],
             }
         )
     )

@@ -38,7 +38,12 @@ from jax import lax
 from .compact import make_run_compacted
 from .core import EngineConfig, Workload, make_init
 
-__all__ = ["make_repeat_program", "measure_throughput", "null_dispatch_stats"]
+__all__ = [
+    "make_repeat_program",
+    "measure_throughput",
+    "measure_latency",
+    "null_dispatch_stats",
+]
 
 
 def make_repeat_program(
@@ -100,6 +105,62 @@ def make_repeat_program(
     return jax.jit(program)
 
 
+def _calibrate_and_measure(
+    program,
+    n_seeds: int,
+    target_wall_s: float,
+    n_measure: int,
+    seed_base: int,
+    max_repeats: int,
+    cal_repeats: int = 1,
+):
+    """Shared sizing + timing scaffold for the measure_* entry points.
+
+    Compile, calibrate with one ``cal_repeats``-sized dispatch, pick
+    ``repeats`` to reach ``target_wall_s``, then grow it until the
+    realized wall does (the calibration dispatch rides the very jitter
+    — or cache warm-up — this harness defeats, so a bad sample there
+    would mis-size every measured cell; each probe doubles as a warm
+    run). Returns ``(repeats, cal_wall, walls, sims, ovf_tot,
+    halted_min)`` over ``n_measure`` timed dispatches.
+    """
+    jax.block_until_ready(program(np.uint64(seed_base), 1))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(program(np.uint64(seed_base), cal_repeats))
+    cal_wall = time.perf_counter() - t0
+
+    repeats = min(
+        max(
+            cal_repeats,
+            int(np.ceil(target_wall_s / max(cal_wall / cal_repeats, 1e-9))),
+        ),
+        max_repeats,
+    )
+    for _ in range(8):
+        t0 = time.perf_counter()
+        jax.block_until_ready(program(np.uint64(seed_base), repeats))
+        sized_wall = time.perf_counter() - t0
+        if sized_wall >= target_wall_s * 0.6 or repeats >= max_repeats:
+            break
+        per_rep = sized_wall / repeats
+        repeats = min(
+            max(repeats + 1, int(np.ceil(target_wall_s / max(per_rep, 1e-9)))),
+            max_repeats,
+        )
+
+    walls, sims, ovf_tot, halted_min = [], [], 0, None
+    for m in range(n_measure):
+        base = np.uint64(seed_base + (m + 1) * repeats * n_seeds)
+        t0 = time.perf_counter()
+        sim_ns, ovf, halted = jax.block_until_ready(program(base, repeats))
+        walls.append(time.perf_counter() - t0)
+        sims.append(int(sim_ns) / 1e9)
+        ovf_tot += int(ovf)
+        h = int(halted)
+        halted_min = h if halted_min is None else min(halted_min, h)
+    return repeats, cal_wall, walls, sims, ovf_tot, halted_min
+
+
 def measure_throughput(
     wl: Workload,
     cfg: EngineConfig,
@@ -128,41 +189,9 @@ def measure_throughput(
     program = make_repeat_program(
         wl, cfg, max_steps, n_seeds, seed_mod, layout, time32, shrink, min_size
     )
-    # calibration: one single-batch dispatch (after the compile run)
-    jax.block_until_ready(program(np.uint64(seed_base), 1))
-    t0 = time.perf_counter()
-    jax.block_until_ready(program(np.uint64(seed_base), 1))
-    cal_wall = time.perf_counter() - t0
-
-    repeats = min(
-        max(1, int(np.ceil(target_wall_s / max(cal_wall, 1e-6)))), max_repeats
+    repeats, cal_wall, walls, sims, ovf_tot, halted_min = _calibrate_and_measure(
+        program, n_seeds, target_wall_s, n_measure, seed_base, max_repeats
     )
-    # re-check the sized dispatch: the single calibration dispatch rides
-    # the very jitter this harness defeats, so a jitter spike there
-    # would under-size every measured cell. Grow until the sized wall
-    # actually reaches the target (each probe doubles as a warm run).
-    for _ in range(8):
-        t0 = time.perf_counter()
-        jax.block_until_ready(program(np.uint64(seed_base), repeats))
-        sized_wall = time.perf_counter() - t0
-        if sized_wall >= target_wall_s * 0.6 or repeats >= max_repeats:
-            break
-        per_rep = sized_wall / repeats
-        repeats = min(
-            max(repeats + 1, int(np.ceil(target_wall_s / max(per_rep, 1e-9)))),
-            max_repeats,
-        )
-
-    walls, sims, ovf_tot, halted_min = [], [], 0, None
-    for m in range(n_measure):
-        base = np.uint64(seed_base + (m + 1) * repeats * n_seeds)
-        t0 = time.perf_counter()
-        sim_ns, ovf, halted = jax.block_until_ready(program(base, repeats))
-        walls.append(time.perf_counter() - t0)
-        sims.append(int(sim_ns) / 1e9)
-        ovf_tot += int(ovf)
-        h = int(halted)
-        halted_min = h if halted_min is None else min(halted_min, h)
 
     # rate per dispatch = its OWN simulated seconds / its wall (seed
     # blocks differ, so sim time varies slightly across dispatches)
@@ -182,6 +211,54 @@ def measure_throughput(
         ),
         "overflow": ovf_tot,
         "all_halted": halted_min == repeats * n_seeds,
+    }
+
+
+def measure_latency(
+    wl: Workload,
+    cfg: EngineConfig,
+    max_steps: int,
+    target_wall_s: float = 3.5,
+    n_measure: int = 3,
+    seed_base: int = 0,
+    seed_mod: int = 131072,
+    max_repeats: int = 131072,
+    layout: str | None = None,
+    time32: bool | None = None,
+) -> dict:
+    """Wall microseconds per complete single-seed sim, sized dispatches.
+
+    The latency analog of :func:`measure_throughput` for deliberately
+    single-seed configs (BASELINE's pingpong): one seed cannot amortize
+    dispatch overhead into a throughput quote, so instead ``repeats``
+    independent single-seed sims are packed into one multi-second
+    dispatch and the quote is median wall-per-sim. Same correctness
+    contract: ``overflow`` must be 0 and ``all_halted`` True for the
+    number to be quotable — callers check.
+    """
+    program = make_repeat_program(
+        wl, cfg, max_steps, 1, seed_mod, layout, time32, min_size=1
+    )
+    # cal_repeats=32: a single 1-seed run is far too short to time
+    repeats, cal_wall, walls, sims, ovf_tot, halted_min = _calibrate_and_measure(
+        program, 1, target_wall_s, n_measure, seed_base, max_repeats,
+        cal_repeats=32,
+    )
+
+    lat_us = np.asarray(walls) / repeats * 1e6
+    med = float(np.median(lat_us))
+    return {
+        "n_seeds": 1,
+        "repeats": int(repeats),
+        "calibration_wall_s": round(cal_wall, 4),
+        "dispatch_walls_s": [round(w, 4) for w in walls],
+        "wall_us_per_sim_median": round(med, 2),
+        "spread_pct": round(
+            100.0 * float(lat_us.max() - lat_us.min()) / max(med, 1e-9), 1
+        ),
+        "sim_s_per_s": round(float(np.sum(sims) / np.sum(walls)), 2),
+        "overflow": ovf_tot,
+        "all_halted": halted_min == repeats,
     }
 
 

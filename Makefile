@@ -3,11 +3,16 @@
 # the duality is sim vs std, plus the native components and the
 # determinism re-check).
 #
-#   make check   — everything below, in order
+#   make check   — the default gate: native + test + determinism +
+#                  bench-smoke (test tier excludes -m slow)
+#   make check-full — same but with the slow tier included
 #   make native  — build the C++ components (oracle + 3 transports)
-#   make test    — full suite on the 8-device virtual CPU platform
+#   make test    — default suite on the 8-device virtual CPU platform
 #                  (sim tests, dual-mode/std tests, oracle bit-identical
-#                  compare, sharded-equality tests, transports)
+#                  compare, sharded-equality tests, transports; the
+#                  compile-heaviest redundant cross-check variants are
+#                  marked `slow` and excluded here)
+#   make test-full — the whole suite including the slow tier
 #   make determinism — re-run the runtime suite with the replay checker
 #                  forced on (MADSIM_TEST_CHECK_DETERMINISM=1)
 #   make bench-smoke — one tiny engine measurement + the RPC bench's
@@ -16,15 +21,22 @@
 PY      ?= python
 TESTENV ?= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: check native test determinism bench-smoke bench-tpu-snapshot clean
+.PHONY: check check-full native test test-full determinism bench-smoke \
+        bench-tpu-snapshot clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
+
+check-full: native test-full determinism bench-smoke
+	@echo "== make check-full: all gates passed =="
 
 native:
 	$(MAKE) -C native
 
 test: native
+	$(TESTENV) $(PY) -m pytest tests/ -q -m "not slow"
+
+test-full: native
 	$(TESTENV) $(PY) -m pytest tests/ -q
 
 determinism: native

@@ -129,6 +129,16 @@ def main() -> None:
             f"not a cross-backend run: accel={acc['platform']} "
             f"cpu={cpu['platform']} (is the accelerator visible?)"
         )
+    # the artifact of record must certify every oracle-covered family —
+    # refuse to bank an under-covering run (round-3's committed JSON
+    # silently covered 5 of 8)
+    expected = {
+        "raft", "microbench", "pingpong", "broadcast", "kvchaos",
+        "raftlog", "twophase", "paxos",
+    }
+    missing = expected - set(acc["configs"])
+    if missing:
+        raise SystemExit(f"cross-backend run missing families: {sorted(missing)}")
     report = {
         "accel_platform": acc["platform"],
         "cpu_platform": cpu["platform"],

@@ -38,7 +38,14 @@ def _run_both(name, n_seeds, max_steps, shrink=2, min_size=8):
     return ref, out
 
 
-@pytest.mark.parametrize("name", ["raft", "broadcast", "kvchaos"])
+@pytest.mark.parametrize(
+    "name",
+    ["raft"]
+    + [
+        pytest.param(n, marks=pytest.mark.slow)
+        for n in ["broadcast", "kvchaos"]
+    ],
+)
 def test_compacted_equals_lockstep(name):
     """Full runs (every seed halts) across three workload families,
     including kill/restart + clog chaos (kvchaos)."""

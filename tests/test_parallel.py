@@ -64,6 +64,7 @@ def test_raft_sharded_equals_unsharded(n_devices):
     assert_states_equal(ref, out)
 
 
+@pytest.mark.slow
 def test_kvchaos_payload_sharded_equals_unsharded():
     # payload arena words must survive the sharded path too
     wl = make_kvchaos(writes=3, payload=True)
@@ -137,7 +138,9 @@ def assert_compacted_equal(ref, out):
         )
 
 
-@pytest.mark.parametrize("name", ["raft", "kvchaos"])
+@pytest.mark.parametrize(
+    "name", ["raft", pytest.param("kvchaos", marks=pytest.mark.slow)]
+)
 def test_shard_run_compacted_equals_unsharded(name):
     # per-device local compaction: phase boundaries fall at different
     # steps than the global runner's, but rows are independent, so
@@ -174,6 +177,7 @@ def test_shard_run_compacted_rejects_uneven_split():
         run(state)
 
 
+@pytest.mark.slow
 def test_shard_run_compacted_at_step_cap():
     # a cap where SOME seeds have halted and some are live: shards hit
     # different compaction points (banked rows diverge per shard) and

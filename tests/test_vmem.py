@@ -37,6 +37,7 @@ def test_vmem_runner_matches_plain(blocks):
     assert_states_equal(plain, vmem)
 
 
+@pytest.mark.slow
 def test_vmem_runner_with_payload_and_chaos():
     # kvchaos-payload: nonzero ev_pay exercises the full field set
     wl = make_kvchaos(writes=4, payload=True)

@@ -11,6 +11,7 @@ import numpy as np
 from madsim_tpu.engine import EngineConfig, make_init, make_run_compacted
 from madsim_tpu.engine.measure import (
     make_repeat_program,
+    measure_latency,
     measure_throughput,
     null_dispatch_stats,
 )
@@ -18,8 +19,11 @@ from madsim_tpu.models import make_microbench, make_raft
 
 
 def test_repeat_program_matches_separate_runs():
-    wl = make_raft()
-    cfg = EngineConfig(pool_size=40, loss_p=0.02)
+    # packing equivalence is model-agnostic; microbench's small step
+    # body compiles ~3x faster than raft's (the raft repeat program is
+    # exercised for real by every bench.py run)
+    wl = make_microbench(rounds=40)
+    cfg = EngineConfig(pool_size=16)
     n_seeds, repeats, seed_mod = 32, 3, 64
     program = make_repeat_program(wl, cfg, 400, n_seeds, seed_mod, min_size=8)
     sim_ns, ovf, halted = (int(x) for x in program(np.uint64(5), repeats))
@@ -52,6 +56,22 @@ def test_measure_throughput_reports_quotable_cell():
     assert rec["sim_s_per_s_min"] <= rec["sim_s_per_s_median"] <= rec["sim_s_per_s_max"]
     assert len(rec["dispatch_walls_s"]) == 2
     assert rec["repeats"] >= 1
+
+
+def test_measure_latency_reports_quotable_cell():
+    # the single-seed latency analog (bench.py's pingpong quote)
+    wl = make_microbench(rounds=5)
+    cfg = EngineConfig(pool_size=8)
+    rec = measure_latency(
+        wl, cfg, 200, target_wall_s=0.2, n_measure=2, seed_mod=128
+    )
+    assert rec["overflow"] == 0
+    assert rec["all_halted"]
+    assert rec["n_seeds"] == 1
+    assert rec["wall_us_per_sim_median"] > 0
+    assert rec["sim_s_per_s"] > 0
+    assert len(rec["dispatch_walls_s"]) == 2
+    assert rec["repeats"] >= 32
 
 
 def test_null_dispatch_stats_shape():

@@ -34,6 +34,12 @@ pytestmark = pytest.mark.skipif(
     reason="native toolchain unavailable",
 )
 
+# engine==oracle is layout-independent (the dense/scatter cross is
+# itself gated by check_layouts in the default tier), so the default
+# gate compares one lowering per family — scatter, the CPU-native one —
+# and the dense twin rides the full tier
+LAYOUTS = [pytest.param("dense", marks=pytest.mark.slow), "scatter"]
+
 
 def engine_batch(wl, cfg, seeds, n_steps, layout=None):
     init = make_init(wl, cfg)
@@ -84,7 +90,7 @@ def test_microbench_traces_bit_identical():
     compare(wl, cfg, list(range(8)), 220, rounds=200)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_raft_traces_bit_identical(layout):
     # both lowerings of the step (the TPU dense form and the CPU scatter
     # form) must match the oracle bit-for-bit
@@ -99,7 +105,7 @@ def test_raft_with_time_limit_bit_identical():
     compare(wl, cfg, [3, 9, 27], 400)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_broadcast_traces_bit_identical(layout):
     # partition chaos + packet loss: the clog/unclog + retransmit path
     # (the only oracle workload exercising the clogged-reschedule
@@ -122,7 +128,7 @@ def test_kvchaos_traces_bit_identical():
     compare(wl, cfg, list(range(12)), 500, writes=5)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_kvchaos_payload_traces_bit_identical(layout):
     # the payload arena: client-drawn value words ride WRITE/REPL events
     # and feed the trace hash — a payload divergence anywhere fails here
@@ -145,7 +151,7 @@ def test_big_seed_values():
     compare(wl, cfg, seeds, 150, rounds=3)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_twophase_traces_bit_identical(layout):
     # 2PC: stored votes, phase-aware retransmits, participant
     # kill/restart — the sixth oracle-verified protocol family
@@ -160,7 +166,7 @@ def test_twophase_no_chaos_bit_identical():
     compare(wl, cfg, list(range(8)), 400, txns=3, chaos=False)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_raftlog_traces_bit_identical(layout):
     # raft log replication + leader crash — the seventh oracle-verified
     # protocol family (payload arena carries the full log in appends)
@@ -185,7 +191,7 @@ def test_raftlog_durable_bit_identical():
     compare(wl, cfg, list(range(10)), 3000)
 
 
-@pytest.mark.parametrize("layout", ["dense", "scatter"])
+@pytest.mark.parametrize("layout", LAYOUTS)
 def test_paxos_traces_bit_identical(layout):
     # single-decree paxos + proposer crash — the eighth oracle-verified
     # protocol family (dueling proposers, NACK fast-forward)

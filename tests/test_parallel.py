@@ -54,7 +54,13 @@ def assert_states_equal(a, b):
         assert np.array_equal(av, bv), f"field {name} diverged"
 
 
-@pytest.mark.parametrize("n_devices", [1, 2, 8])
+# the 8-device case is the strongest form (the 1/2-device meshes are
+# degenerate/weaker variants of the same claim); they ride the full tier
+@pytest.mark.parametrize(
+    "n_devices",
+    [pytest.param(1, marks=pytest.mark.slow),
+     pytest.param(2, marks=pytest.mark.slow), 8],
+)
 def test_raft_sharded_equals_unsharded(n_devices):
     wl = make_raft()
     cfg = EngineConfig(pool_size=64, loss_p=0.05)
@@ -138,9 +144,14 @@ def assert_compacted_equal(ref, out):
         )
 
 
-@pytest.mark.parametrize(
-    "name", ["raft", pytest.param("kvchaos", marks=pytest.mark.slow)]
-)
+# ~38 s cold: 2 x 5-phase compaction programs. The sharded+compacted
+# combination is also proven (at mesh scale, vs the unsharded banked
+# path) by __graft_entry__.dryrun_multichip on every driver run, and
+# the default tier keeps sharded-lockstep (raft_sharded[8]) and
+# unsharded-compaction (test_compact raft) separately — so both
+# families of this test ride the full tier
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["raft", "kvchaos"])
 def test_shard_run_compacted_equals_unsharded(name):
     # per-device local compaction: phase boundaries fall at different
     # steps than the global runner's, but rows are independent, so
@@ -154,13 +165,17 @@ def test_shard_run_compacted_equals_unsharded(name):
     wl, cfg = factory(), EngineConfig(**kw)
     seeds = np.arange(128, dtype=np.uint64)
     init = make_init(wl, cfg)
-    ref = jax.block_until_ready(jax.jit(make_run_while(wl, cfg, 2000))(init(seeds)))
-    solo = make_run_compacted(wl, cfg, 2000, shrink=2, min_size=4)(init(seeds))
+    # compacted == lockstep is already asserted per family by
+    # tests/test_compact.py; compiling a third (lockstep) 2000-step
+    # program here cost ~20 s cold for no extra information — the claim
+    # under test is sharded == unsharded on the compacted path.
+    # min_size=8 keeps a compaction boundary inside every 16-seed shard
+    # (16→8) while trimming the phase count (and compile) vs min_size=4
+    solo = make_run_compacted(wl, cfg, 2000, shrink=2, min_size=8)(init(seeds))
     mesh = make_mesh(jax.devices())
     sharded = shard_run_compacted(
-        wl, cfg, 2000, mesh, shrink=2, min_size=4
+        wl, cfg, 2000, mesh, shrink=2, min_size=8
     )(shard_state(init(seeds), mesh))
-    assert_compacted_equal(ref, sharded)
     assert_compacted_equal(solo, sharded)
 
 

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from madsim_tpu.engine import EngineConfig, search_seeds
-from madsim_tpu.models import make_kvchaos, make_raft
+from madsim_tpu.models import make_kvchaos, make_microbench, make_raft
 
 
 def test_healthy_workload_has_no_violations():
@@ -65,8 +65,10 @@ def test_failing_seed_reproduces_in_isolation():
 
 
 def test_invariant_shape_is_validated():
-    wl = make_raft()
-    cfg = EngineConfig(pool_size=48)
+    # arg-validation only — the cheapest model body suffices (compiling
+    # the raft search program here cost 6 s cold for a ValueError)
+    wl = make_microbench(rounds=5)
+    cfg = EngineConfig(pool_size=8)
     with pytest.raises(ValueError, match="boolean array"):
         search_seeds(wl, cfg, lambda v: np.bool_(True), n_seeds=8, max_steps=50)
 
@@ -99,6 +101,7 @@ def test_search_reuses_compiled_run():
     assert len(search._RUN_CACHE) == before + 1
 
 
+@pytest.mark.slow
 def test_compact_search_same_verdicts_and_traces():
     # compact=True runs the seed-compaction path: identical per-seed
     # verdicts and trace hashes, narrower view (node_state etc. only)

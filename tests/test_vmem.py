@@ -26,7 +26,11 @@ def assert_states_equal(a, b):
         assert np.array_equal(fa, fb), f"field {f} diverged"
 
 
-@pytest.mark.parametrize("blocks", [1, 4])
+# blocks=4 (the gridded case) subsumes the single-block mechanics;
+# blocks=1 rides the full tier
+@pytest.mark.parametrize(
+    "blocks", [pytest.param(1, marks=pytest.mark.slow), 4]
+)
 def test_vmem_runner_matches_plain(blocks):
     wl = make_raft()
     cfg = EngineConfig(pool_size=40, loss_p=0.02)

@@ -232,13 +232,22 @@ def main():
 
     full = results["full_step"]["us_per_step"]
     parts = {n: results[n]["us_per_step"] for n in results if n != "full_step"}
+    # the step uses ONE placement lowering (scatter on cpu, dense
+    # elsewhere — make_step layout auto); both variants are measured
+    # for comparison, but the decomposition must subtract only the
+    # active one or the unattributed residue double-counts placement
+    inactive = "dense_place_2fields" if platform == "cpu" else "emit_scatters"
+    active_parts = {n: v for n, v in parts.items() if n != inactive}
     print(json.dumps({
         "summary": {
             "platform": platform,
             "n_seeds": N_SEEDS,
             "full_us_per_step": full,
             "parts_us_per_step": parts,
-            "unattributed_us_per_step": round(full - sum(parts.values()), 2),
+            "active_layout": "scatter" if platform == "cpu" else "dense",
+            "unattributed_us_per_step": round(
+                full - sum(active_parts.values()), 2
+            ),
         }
     }), flush=True)
 

@@ -15,8 +15,10 @@ profile quantifying the transport overhead the sizing defeats. A cell
 is quotable only if ``overflow == 0`` and ``all_halted`` — check
 before quoting.
 
-Usage: python examples/scaling_sweep.py [out.json] [--quick]
+Usage: python examples/scaling_sweep.py [out.json] [--quick] [cpu]
   --quick: 2 s dispatches, 2 measures (for smoke runs)
+  cpu: pin the CPU backend (jax.config — env vars can't, sitecustomize
+       wins; required for fallback sweeps while the tunnel is wedged)
 """
 
 from __future__ import annotations
@@ -29,6 +31,13 @@ import time
 
 import jax
 
+if "cpu" in sys.argv[1:]:
+    # env vars cannot pin the platform here: the image's sitecustomize
+    # registers the axon plugin at interpreter start, and with a wedged
+    # tunnel any axon init hangs forever — only a config update wins
+    # (same seam as profile_step.py / the bench children)
+    jax.config.update("jax_platforms", "cpu")
+
 from madsim_tpu.engine import EngineConfig
 from madsim_tpu.engine.measure import measure_throughput, null_dispatch_stats
 from madsim_tpu.models import BENCH_SPECS
@@ -37,7 +46,7 @@ SEED_COUNTS = [1024, 4096, 16384, 65536]
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    args = [a for a in sys.argv[1:] if not a.startswith("--") and a != "cpu"]
     quick = "--quick" in sys.argv
     out_path = args[0] if args else "SCALING_SWEEP.json"
     target_wall = 2.0 if quick else 5.0

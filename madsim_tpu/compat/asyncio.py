@@ -8,6 +8,13 @@ time; Python has no build-time cfg, so every entry point here checks
 uses the deterministic runtime (virtual time, seeded scheduling), outside
 it delegates to the real asyncio module.
 
+Note: since the loop-level interposition landed
+(:mod:`madsim_tpu.runtime.aio`), even code importing the REAL asyncio
+module works inside sims — the stdlib primitives run against a
+sim-backed loop installed in the running-loop slot. This module remains
+the explicit-import surface (stable API, per-call dual dispatch for
+code that must run in both worlds).
+
 Covered surface (the part madsim-tokio simulates: task/time/sync —
 lib.rs:4-52; io/fs/signal are delegated):
   sleep, wait_for, timeout, create_task, ensure_future, gather, wait,

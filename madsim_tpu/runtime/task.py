@@ -25,9 +25,11 @@ The reference also interposes ``sched_getaffinity``/``sysconf``/
 
 from __future__ import annotations
 
+import asyncio as _real_asyncio
+
 from typing import Any, Callable, Coroutine, Optional
 
-from . import context
+from . import aio, context
 from .future import SimFuture
 from .mpsc import RandomQueue
 from .rand import GlobalRng
@@ -113,6 +115,8 @@ class Task:
         "finished",
         "_close_pending",
         "_pending_throw",
+        "_aio_shim",
+        "_aio_bridge",
     )
 
     def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo, name: str):
@@ -124,6 +128,12 @@ class Task:
         self.scheduled = False
         self.finished = False
         self._close_pending = False
+        # lazily-built asyncio.current_task() stand-in (runtime/aio.py)
+        self._aio_shim = None
+        # the asyncio.Future returned by a raw asyncio.create_task, if
+        # this task was spawned that way — switches exception routing to
+        # asyncio semantics (runtime/aio.py, _on_panic)
+        self._aio_bridge = None
         # exception injected at the task's next poll (the cancellation
         # mechanism behind compat asyncio.timeout(): the timer arms this
         # and reschedules the task, and the executor throws it into the
@@ -199,6 +209,10 @@ class Executor:
         # node create/reset (runtime/mod.rs:68-79 sims registry).
         self.simulators: list = []
         self._pending_panic: Optional[BaseException] = None
+        # raw-asyncio interposition (runtime/aio.py): installed in the
+        # running-loop slot around every poll so unmodified asyncio code
+        # runs on simulated time
+        self.aio_loop = aio.SimEventLoop(self)
 
     # ---- spawning -------------------------------------------------------
     def spawn_on(self, node: NodeInfo, coro: Coroutine, name: str = "") -> JoinHandle:
@@ -264,11 +278,15 @@ class Executor:
     def _poll(self, task: Task) -> None:
         try:
             with context.enter_task(task):
-                if task._pending_throw is not None:
-                    exc_in, task._pending_throw = task._pending_throw, None
-                    yielded = task.coro.throw(exc_in)
-                else:
-                    yielded = task.coro.send(None)
+                prev_loop = aio.enter_poll(self.aio_loop, task)
+                try:
+                    if task._pending_throw is not None:
+                        exc_in, task._pending_throw = task._pending_throw, None
+                        yielded = task.coro.throw(exc_in)
+                    else:
+                        yielded = task.coro.send(None)
+                finally:
+                    aio.exit_poll(self.aio_loop, task, prev_loop)
         except StopIteration as stop:
             task.finished = True
             task._fut.set_result(stop.value)
@@ -284,23 +302,42 @@ class Executor:
                 except RuntimeError:
                     pass
                 return
-            if not isinstance(yielded, SimFuture):
+            if task.node.killed:
+                task.kill()
+            elif isinstance(yielded, SimFuture):
+                yielded.add_waker(self._waker(task))
+            elif yielded is None:
+                # a bare `yield` — asyncio.sleep(0)'s __sleep0 / yield-now:
+                # hand the scheduler one turn, resume on a later drain
+                self._schedule(task)
+            elif aio.is_asyncio_future(yielded):
+                # raw asyncio await (stdlib Future/Queue/Event/...): the
+                # executor side of the asyncio await protocol — resume the
+                # task when the future resolves (runtime/aio.py)
+                aio.bridge_asyncio_future(yielded, self._waker(task))
+            else:
                 task.finished = True
                 err = TypeError(
                     f"task {task.name!r} awaited a non-simulation awaitable "
                     f"({type(yielded).__name__}); only madsim_tpu futures "
-                    f"can be awaited inside the simulator"
+                    f"and asyncio awaitables can be awaited inside the "
+                    f"simulator"
                 )
                 self._pending_panic = err
                 return
-            if task.node.killed:
-                task.kill()
-            else:
-                yielded.add_waker(self._waker(task))
 
     def _on_panic(self, task: Task, exc: BaseException) -> None:
         task.finished = True
         node = task.node
+        if isinstance(exc, _real_asyncio.CancelledError):
+            # asyncio-style cancellation ends ONLY the cancelled task —
+            # the analog of tokio JoinHandle::abort (task.rs:611), which
+            # does not panic the runtime. (Uncaught real exceptions still
+            # fail the whole simulation below.)
+            je = JoinError(f"task {task.name!r} was cancelled")
+            je.__cause__ = exc
+            task._fut.set_exception(je)
+            return
         if node.restart_on_panic and node.id != MAIN_NODE_ID:
             # Kill the node *immediately* (sibling tasks stop, simulator
             # per-node state resets), then restart after a random 1-10 s
@@ -315,6 +352,15 @@ class Executor:
                 self.time.now_ns() + delay_ns,
                 lambda: self.restart_node(node_id),
             )
+            return
+        if task._aio_bridge is not None:
+            # the task was created via RAW asyncio.create_task: asyncio
+            # exception semantics — the exception is stored for the
+            # awaiter (gather/await/return_exceptions all behave as in
+            # real asyncio) instead of failing the whole simulation
+            je = JoinError(f"task {task.name!r} raised")
+            je.__cause__ = exc
+            task._fut.set_exception(je)
             return
         # A panic in any other task fails the whole simulation, exactly like
         # the reference where the unwind propagates through block_on. (To

@@ -317,6 +317,85 @@ def test_sim_inside_real_loop_restores_slot():
     assert asyncio.run(real_main()) == "ok"
 
 
+def test_raw_taskgroup():
+    # asyncio.TaskGroup (3.11+): create_task via the group, implicit
+    # join at __aexit__ — all through the interposed loop + task shim
+    async def main():
+        async def job(i):
+            await asyncio.sleep(0.01 * (i + 1))
+            return i * 10
+
+        async with asyncio.TaskGroup() as tg:
+            ts = [tg.create_task(job(i)) for i in range(4)]
+        return [t.result() for t in ts]
+
+    assert run_sim(main) == [0, 10, 20, 30]
+
+
+def test_raw_taskgroup_failure_cancels_siblings():
+    async def main():
+        events = []
+
+        async def boom():
+            await asyncio.sleep(0.01)
+            raise RuntimeError("tg-boom")
+
+        async def slow():
+            try:
+                await asyncio.sleep(100.0)
+            except asyncio.CancelledError:
+                events.append("sibling-cancelled")
+                raise
+
+        try:
+            async with asyncio.TaskGroup() as tg:
+                tg.create_task(boom())
+                tg.create_task(slow())
+        except* RuntimeError:
+            events.append("group-raised")
+        return events
+
+    out = run_sim(main)
+    assert "sibling-cancelled" in out and "group-raised" in out
+
+
+def test_raw_as_completed_orders_by_virtual_time():
+    async def main():
+        async def job(i):
+            await asyncio.sleep(0.01 * (i + 1))
+            return i
+
+        results = []
+        for fut in asyncio.as_completed([job(2), job(0), job(1)]):
+            results.append(await fut)
+        return results
+
+    assert run_sim(main) == [0, 1, 2]
+
+
+def test_raw_wait_for_over_sim_native_awaitable():
+    # stdlib wait_for wrapping a madsim-native awaitable: ensure_future
+    # wraps the coroutine through the interposed loop's create_task
+    async def main():
+        with pytest.raises(TimeoutError):
+            await asyncio.wait_for(ms.sleep(100.0), timeout=0.05)
+        return "ok"
+
+    assert run_sim(main) == "ok"
+
+
+def test_raw_timeout_at_uses_loop_clock():
+    async def main():
+        t = asyncio.get_event_loop().time()
+        with pytest.raises(TimeoutError):
+            async with asyncio.timeout_at(t + 0.05):
+                await asyncio.sleep(50.0)
+        return ms.now_ns()
+
+    # the deadline rode the VIRTUAL clock: ~0.05 s, not 50
+    assert run_sim(main) < 1_000_000_000
+
+
 def test_raw_asyncio_with_chaos_kill():
     # raw-asyncio code on a killed node: its tasks die with the node
     async def main():

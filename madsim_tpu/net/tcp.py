@@ -118,8 +118,23 @@ class TcpStream:
         self._tx.shutdown()
 
     def close(self) -> None:
-        """Close the whole stream, releasing both directions' resources."""
+        """Close the whole stream, releasing both directions' resources.
+        Reset-like: a peer blocked in ``read`` wakes with EOF immediately,
+        even if sent bytes are still in flight (the node-reset semantics
+        of tcp/mod.rs:98-208)."""
         self._tx.close()
+        if self._owned_ep is not None:
+            self._owned_ep.close()
+            self._owned_ep = None
+
+    def close_graceful(self) -> None:
+        """FIN-like close: the write half shuts down, so the peer sees
+        EOF only AFTER all in-flight bytes deliver (real-TCP close
+        ordering — the asyncio transport layer needs this; plain
+        ``close`` is a reset). Our own future reads return EOF; the
+        reverse-direction pipes close when the peer closes its end."""
+        self._tx.shutdown()
+        self._eof = True
         if self._owned_ep is not None:
             self._owned_ep.close()
             self._owned_ep = None

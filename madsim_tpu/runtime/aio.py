@@ -46,6 +46,7 @@ Semantics notes (parity choices, not accidents):
 from __future__ import annotations
 
 import asyncio as _aio
+import contextvars
 from typing import Any, Callable, Coroutine, Optional
 
 from . import context
@@ -230,14 +231,6 @@ class SimEventLoop:
         to the sim task's join future. ``fut.cancel()`` requests
         cancellation asyncio-style (CancelledError at the task's await
         point; a suppressed cancel still yields the task's result)."""
-        if context is not None:
-            # per-task contextvars isolation would require polling the
-            # coroutine under Context.run — not implemented; fail loud
-            # rather than silently running in the ambient context
-            raise NotImplementedError(
-                "create_task(context=...) is not supported inside the "
-                "simulator"
-            )
         ex = self._executor
         cur = context_try_current()
         node = cur.node if cur is not None else ex.main_node
@@ -245,6 +238,14 @@ class SimEventLoop:
             node, coro, name or getattr(coro, "__name__", "aio-task")
         )
         task = handle._task
+        # asyncio.Task parity: every poll runs under the task's Context —
+        # the supplied one, or (as asyncio.Task does) a COPY of the
+        # current context, so a child's contextvar mutations never leak
+        # into the parent or siblings (the executor's _poll honors
+        # _aio_ctx)
+        task._aio_ctx = (
+            context if context is not None else contextvars.copy_context()
+        )
         fut = SimEventLoop._BridgeFuture(loop=self)
         fut._sim_task = task
         task._aio_bridge = fut
@@ -266,6 +267,47 @@ class SimEventLoop:
                     fut.set_exception(cause if cause is not None else exc)
 
         sim_fut.add_waker(on_sim_done)
+        return fut
+
+    # -- network (asyncio.open_connection / start_server) ------------------
+    async def create_connection(self, protocol_factory, host=None, port=None,
+                                *, ssl=None, **kwargs):
+        """Backs raw ``asyncio.open_connection`` with the simulated TCP
+        (net/aio_streams.py adapts TcpStream to the Transport contract;
+        lazy import — runtime must not import net at module load)."""
+        if ssl is not None:
+            raise NotImplementedError("ssl is not simulated")
+        from ..net import aio_streams
+
+        return await aio_streams.create_connection(
+            self, protocol_factory, host, port, **kwargs
+        )
+
+    async def create_server(self, protocol_factory, host=None, port=None,
+                            *, ssl=None, **kwargs):
+        """Backs raw ``asyncio.start_server`` with the simulated TCP."""
+        if ssl is not None:
+            raise NotImplementedError("ssl is not simulated")
+        from ..net import aio_streams
+
+        return await aio_streams.create_server(
+            self, protocol_factory, host, port, **kwargs
+        )
+
+    def run_in_executor(self, executor, func, *args):
+        """Simulated ``run_in_executor``: real worker threads are
+        forbidden inside a sim (the thread-spawn guard, intercept.py),
+        so the callable runs synchronously at the current virtual
+        instant — any ``time.sleep`` it performs advances the virtual
+        clock via the interposed stdlib. This also powers
+        ``asyncio.to_thread``. Only the default executor (None) is
+        meaningful; a custom executor object is accepted and ignored
+        (there is exactly one simulated "thread")."""
+        fut = self.create_future()
+        try:
+            fut.set_result(func(*args))
+        except BaseException as exc:  # noqa: BLE001 - mirror real executor
+            fut.set_exception(exc)
         return fut
 
     # -- misc hooks stdlib code may touch ----------------------------------

@@ -117,6 +117,7 @@ class Task:
         "_pending_throw",
         "_aio_shim",
         "_aio_bridge",
+        "_aio_ctx",
     )
 
     def __init__(self, task_id: int, coro: Coroutine, node: NodeInfo, name: str):
@@ -134,6 +135,9 @@ class Task:
         # this task was spawned that way — switches exception routing to
         # asyncio semantics (runtime/aio.py, _on_panic)
         self._aio_bridge = None
+        # contextvars.Context every poll runs under, when the task was
+        # created with asyncio.create_task(..., context=ctx)
+        self._aio_ctx = None
         # exception injected at the task's next poll (the cancellation
         # mechanism behind compat asyncio.timeout(): the timer arms this
         # and reschedules the task, and the executor throws it into the
@@ -243,6 +247,7 @@ class Executor:
                 exc, self._pending_panic = self._pending_panic, None
                 raise exc
             if main_fut.done():
+                self._report_unretrieved_aio()
                 return main_fut.result()
             if not self.time.advance_to_next_event():
                 raise DeadlockError(
@@ -282,7 +287,14 @@ class Executor:
                 try:
                     if task._pending_throw is not None:
                         exc_in, task._pending_throw = task._pending_throw, None
-                        yielded = task.coro.throw(exc_in)
+                        if task._aio_ctx is not None:
+                            yielded = task._aio_ctx.run(task.coro.throw, exc_in)
+                        else:
+                            yielded = task.coro.throw(exc_in)
+                    elif task._aio_ctx is not None:
+                        # asyncio.Task parity: every poll runs under the
+                        # task's contextvars Context (create_task context=)
+                        yielded = task._aio_ctx.run(task.coro.send, None)
                     else:
                         yielded = task.coro.send(None)
                 finally:
@@ -325,6 +337,34 @@ class Executor:
                 )
                 self._pending_panic = err
                 return
+
+    def _report_unretrieved_aio(self) -> None:
+        """End-of-sim debugging aid: a raw ``asyncio.create_task`` task
+        that died with an exception nobody awaited would otherwise be
+        perfectly silent (asyncio semantics store it in the future; the
+        GC-time "never retrieved" hook is deliberately a no-op because
+        GC timing is nondeterministic). The END of the simulation IS a
+        deterministic point, so report each one on stderr here —
+        iteration order (node id, task creation order) is seeded-stable."""
+        import sys as _sys
+
+        for node_id in sorted(self.nodes):
+            for task in self.nodes[node_id].tasks:
+                fut = task._aio_bridge
+                if (
+                    fut is not None
+                    and fut.done()
+                    and not fut.cancelled()
+                    # flag FIRST: .exception() clears _log_traceback
+                    and getattr(fut, "_log_traceback", False)
+                    and fut.exception() is not None
+                ):
+                    print(
+                        f"note: asyncio task {task.name!r} (node {node_id}) "
+                        f"died with an unretrieved exception: "
+                        f"{fut.exception()!r}",
+                        file=_sys.stderr,
+                    )
 
     def _on_panic(self, task: Task, exc: BaseException) -> None:
         task.finished = True

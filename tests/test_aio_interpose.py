@@ -259,20 +259,63 @@ def test_raw_cancel_can_be_suppressed():
     assert run_sim(main) == "survived"
 
 
-def test_raw_create_task_context_kwarg_is_loud():
+def test_raw_create_task_context_kwarg():
     import contextvars
+
+    cv = contextvars.ContextVar("cv", default="outer")
 
     async def main():
         async def child():
-            return 1
+            await asyncio.sleep(0.01)  # context must survive suspension
+            return cv.get()
 
-        coro = child()
-        with pytest.raises(NotImplementedError, match="context"):
-            asyncio.create_task(coro, context=contextvars.copy_context())
-        coro.close()
-        return "ok"
+        ctx = contextvars.copy_context()
+        ctx.run(cv.set, "inner")
+        t = asyncio.create_task(child(), context=ctx)
+        plain = asyncio.create_task(child())
+        return await t, await plain
 
-    assert run_sim(main) == "ok"
+    assert run_sim(main) == ("inner", "outer")
+
+
+def test_raw_create_task_isolates_context_by_default():
+    # asyncio.Task copies the current context when context=None: a
+    # child's contextvar mutations must not leak into the parent
+    import contextvars
+
+    cv = contextvars.ContextVar("cv2", default="outer")
+
+    async def main():
+        async def child():
+            cv.set("leaked?")
+            await asyncio.sleep(0.01)
+            return cv.get()
+
+        t = asyncio.create_task(child())
+        inner = await t
+        return inner, cv.get()
+
+    assert run_sim(main) == ("leaked?", "outer")
+
+
+def test_raw_to_thread_and_run_in_executor():
+    import time as _time
+
+    async def main():
+        def blocking(x):
+            _time.sleep(0.5)  # interposed: advances VIRTUAL time
+            return x * 2
+
+        t0 = ms.now_ns()
+        r1 = await asyncio.to_thread(blocking, 21)
+        r2 = await asyncio.get_running_loop().run_in_executor(
+            None, blocking, 4
+        )
+        return r1, r2, ms.now_ns() - t0
+
+    r1, r2, elapsed = run_sim(main)
+    assert (r1, r2) == (42, 8)
+    assert elapsed >= 1_000_000_000  # two simulated 0.5 s sleeps
 
 
 def test_unknown_awaitable_still_rejected():

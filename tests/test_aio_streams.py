@@ -1,0 +1,278 @@
+"""Raw asyncio streams over the simulated network (net/aio_streams.py).
+
+``asyncio.start_server`` / ``asyncio.open_connection`` — the stdlib's
+own StreamReader/StreamWriter machinery — running against NetSim via
+the interposed loop's create_server/create_connection. The analog of
+the reference simulating tokio's TcpStream under the unchanged API
+(sim/net/tcp/stream.rs).
+"""
+
+import asyncio
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.runtime.builder import Builder
+
+
+def run_sim(workload, seed=7):
+    b = Builder()
+    b.seed = seed
+    b.count = 1
+    return b.run(workload)
+
+
+def _echo_cluster():
+    """Returns (main coroutine fn, transcript list). Pure-stdlib echo
+    server + client; only the node scaffolding touches ms APIs."""
+    transcript = []
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    writer.write(b"echo:" + line)
+                    await writer.drain()
+                writer.close()
+                await writer.wait_closed()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 8000)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.05)
+            reader, writer = await asyncio.open_connection("10.0.0.1", 8000)
+            for i in range(3):
+                writer.write(f"msg{i}\n".encode())
+                await writer.drain()
+                line = await reader.readline()
+                transcript.append((line, ms.now_ns()))
+            writer.write_eof()
+            tail = await reader.read()
+            writer.close()
+            return tail
+
+        return await cli.spawn(client())
+
+    return main, transcript
+
+
+def test_stdlib_echo_over_sim_net():
+    main, transcript = _echo_cluster()
+    tail = run_sim(main)
+    assert tail == b""
+    assert [line for line, _t in transcript] == [
+        b"echo:msg0\n", b"echo:msg1\n", b"echo:msg2\n"
+    ]
+    # each round trip took real simulated network time
+    times = [t for _line, t in transcript]
+    assert times == sorted(times) and times[0] > 50_000_000
+
+
+def test_stdlib_echo_is_deterministic():
+    main1, t1 = _echo_cluster()
+    main2, t2 = _echo_cluster()
+    main3, t3 = _echo_cluster()
+    run_sim(main1, seed=21)
+    run_sim(main2, seed=21)
+    run_sim(main3, seed=22)
+    assert t1 == t2, "same seed: identical transcript incl. timestamps"
+    assert t1 != t3, "different seed: different network timings"
+
+
+def test_concurrent_clients_and_peername():
+    async def main():
+        h = ms.Handle.current()
+        peers = []
+
+        async def serve():
+            async def on_client(reader, writer):
+                peers.append(writer.get_extra_info("peername"))
+                data = await reader.readline()
+                writer.write(data.upper())
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 9000)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+
+        async def one(i):
+            await asyncio.sleep(0.01)
+            r, w = await asyncio.open_connection("10.0.0.1", 9000)
+            w.write(f"hello-{i}\n".encode())
+            await w.drain()
+            out = await r.readline()
+            w.close()
+            return out
+
+        outs = []
+        for i in range(3):
+            node = h.create_node().name(f"c{i}").ip(f"10.0.0.{i + 2}").build()
+            outs.append(node.spawn(one(i)))
+        return [await o for o in outs], peers
+
+    outs, peers = run_sim(main)
+    assert sorted(outs) == [b"HELLO-0\n", b"HELLO-1\n", b"HELLO-2\n"]
+    assert sorted(ip for ip, _port in peers) == [
+        "10.0.0.2", "10.0.0.3", "10.0.0.4"
+    ]
+
+
+def test_server_node_kill_resets_client_stream():
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                writer.write(b"hi\n")
+                await writer.drain()
+                await reader.read()  # hold the connection open
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 9100)
+            async with server:
+                await server.serve_forever()
+
+        srv = h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            reader, writer = await asyncio.open_connection("10.0.0.1", 9100)
+            first = await reader.readline()
+            h.kill(srv)
+            # the killed peer's stream drains to EOF (reset semantics,
+            # tcp/mod.rs:98-208)
+            rest = await reader.read()
+            writer.close()
+            return first, rest
+
+        return await cli.spawn(client())
+
+    first, rest = run_sim(main)
+    assert first == b"hi\n"
+    assert rest == b""
+
+
+def test_half_close_request_response():
+    # write_eof as the request delimiter: the server reads to EOF, then
+    # RESPONDS over the still-open write side (eof_received() -> True
+    # keeps the transport alive — real TCP half-close)
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                req = await reader.read()  # to client's EOF
+                writer.write(b"resp:" + req)
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 9200)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            reader, writer = await asyncio.open_connection("10.0.0.1", 9200)
+            writer.write(b"the-request")
+            writer.write_eof()
+            resp = await reader.read()
+            writer.close()
+            return resp
+
+        return await cli.spawn(client())
+
+    assert run_sim(main) == b"resp:the-request"
+
+
+def test_server_close_wakes_serve_forever():
+    # real asyncio Server.close cancels the serve-forever future; the
+    # awaiting task must wake instead of pending forever (which would
+    # DeadlockError the sim if it were the last runnable work)
+    async def main2():
+        server = await asyncio.start_server(lambda r, w: None, "10.0.0.9", 9301)
+
+        async def closer():
+            await asyncio.sleep(0.05)
+            server.close()
+
+        asyncio.create_task(closer())
+        with pytest.raises(asyncio.CancelledError):
+            async with server:
+                await server.serve_forever()
+        return "woke"
+
+    assert run_sim(main2) == "woke"
+
+
+def test_write_after_eof_raises():
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                await reader.read()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 9400)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            _r, writer = await asyncio.open_connection("10.0.0.1", 9400)
+            writer.write_eof()
+            with pytest.raises(RuntimeError, match="write_eof"):
+                writer.write(b"too late")
+            writer.close()
+            return "ok"
+
+        return await cli.spawn(client())
+
+    assert run_sim(main) == "ok"
+
+
+def test_unretrieved_task_exception_reported_at_sim_end(capsys):
+    async def main():
+        async def boom():
+            raise ValueError("silent-boom")
+
+        asyncio.create_task(boom())
+        await asyncio.sleep(0.05)
+        return "done"
+
+    assert run_sim(main) == "done"
+    err = capsys.readouterr().err
+    assert "unretrieved exception" in err and "silent-boom" in err
+
+
+def test_retrieved_task_exception_not_reported(capsys):
+    async def main():
+        async def boom():
+            raise ValueError("seen-boom")
+
+        t = asyncio.create_task(boom())
+        await asyncio.sleep(0.05)
+        with pytest.raises(ValueError):
+            await t
+        return "done"
+
+    assert run_sim(main) == "done"
+    assert "unretrieved" not in capsys.readouterr().err

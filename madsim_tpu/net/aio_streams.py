@@ -25,8 +25,16 @@ from typing import Callable, Optional
 
 from ..runtime.task import spawn
 from .tcp import TcpListener, TcpStream
+from .udp import UdpSocket
 
-__all__ = ["SimTransport", "SimServer", "create_connection", "create_server"]
+__all__ = [
+    "SimTransport",
+    "SimDatagramTransport",
+    "SimServer",
+    "create_connection",
+    "create_server",
+    "create_datagram_endpoint",
+]
 
 _READ_CHUNK = 64 * 1024
 
@@ -187,6 +195,97 @@ class SimTransport:
         pass
 
 
+class SimDatagramTransport:
+    """asyncio.DatagramTransport over the simulated UdpSocket: backs raw
+    ``loop.create_datagram_endpoint`` — stdlib DatagramProtocol code
+    (``datagram_received``/``error_received``) runs against NetSim's
+    datagram loss/latency/partition model (udp.rs:9-73 parity)."""
+
+    def __init__(self, loop, sock: UdpSocket, protocol, remote):
+        self._loop = loop
+        self._sock = sock
+        self._protocol = protocol
+        self._remote = remote  # (ip, port) filter for connected sockets
+        self._closing = False
+        self._closed = False
+        self._send_q: list[tuple[bytes, tuple]] = []
+        self._send_wake = _aio.Event()
+        self._pumps = []
+
+    def _start(self) -> None:
+        self._protocol.connection_made(self)
+        self._pumps.append(spawn(self._recv_pump(), name="udp-recv-pump"))
+        self._pumps.append(spawn(self._send_pump(), name="udp-send-pump"))
+
+    async def _recv_pump(self) -> None:
+        while not self._closed:
+            data, src = await self._sock.recv_from()
+            if self._remote is not None and src != self._remote:
+                continue  # connected-socket filter (udp.py recv parity)
+            self._protocol.datagram_received(data, src)
+
+    async def _send_pump(self) -> None:
+        while True:
+            while not self._send_q:
+                if self._closing:
+                    self._teardown(None)
+                    return
+                self._send_wake.clear()
+                await self._send_wake.wait()
+            data, addr = self._send_q.pop(0)
+            try:
+                await self._sock.send_to(data, addr)
+            except OSError as exc:
+                # datagram semantics: per-packet error, transport lives
+                self._protocol.error_received(exc)
+
+    def _teardown(self, exc) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._sock.close()
+        try:
+            self._protocol.connection_lost(exc)
+        finally:
+            for p in self._pumps:
+                if not p.done():
+                    p.abort()
+
+    # -- asyncio.DatagramTransport surface --------------------------------
+    def get_extra_info(self, name: str, default=None):
+        if name == "sockname":
+            return self._sock.local_addr
+        if name == "peername":
+            return self._remote
+        return default
+
+    def sendto(self, data: bytes, addr=None) -> None:
+        if self._closing or self._closed:
+            return
+        if addr is None:
+            if self._remote is None:
+                raise ValueError("no address given and socket not connected")
+            addr = self._remote
+        elif self._remote is not None and tuple(addr) != tuple(self._remote):
+            raise ValueError(
+                f"invalid address: must be {self._remote} (connected socket)"
+            )
+        self._send_q.append((bytes(data), addr))
+        self._send_wake.set()
+
+    def is_closing(self) -> bool:
+        return self._closing or self._closed
+
+    def close(self) -> None:
+        if self._closing or self._closed:
+            return
+        self._closing = True
+        self._send_wake.set()  # queued datagrams flush, then teardown
+
+    def abort(self) -> None:
+        self._teardown(None)
+
+
 class SimServer:
     """asyncio.Server stand-in returned by ``start_server`` in a sim."""
 
@@ -293,3 +392,19 @@ async def create_server(
     if start_serving:
         server._start()
     return server
+
+
+async def create_datagram_endpoint(
+    loop, protocol_factory: Callable, local_addr=None, remote_addr=None,
+    **kwargs
+):
+    """``loop.create_datagram_endpoint`` for the sim loop."""
+    sock = await UdpSocket.bind(local_addr or ("0.0.0.0", 0))
+    if remote_addr is not None:
+        await sock.connect(remote_addr)
+    protocol = protocol_factory()
+    tr = SimDatagramTransport(
+        loop, sock, protocol, sock.peer_addr
+    )
+    tr._start()
+    return tr, protocol

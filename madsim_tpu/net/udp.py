@@ -55,3 +55,11 @@ class UdpSocket:
             payload, src = await self.recv_from()
             if src == self._peer:
                 return payload
+
+    @property
+    def peer_addr(self) -> Optional[SocketAddr]:
+        return self._peer
+
+    def close(self) -> None:
+        """Release the port binding (sockets are per-node resources)."""
+        self._ep.close()

@@ -294,6 +294,16 @@ class SimEventLoop:
             self, protocol_factory, host, port, **kwargs
         )
 
+    async def create_datagram_endpoint(self, protocol_factory,
+                                       local_addr=None, remote_addr=None,
+                                       **kwargs):
+        """Backs raw datagram protocols with the simulated UDP."""
+        from ..net import aio_streams
+
+        return await aio_streams.create_datagram_endpoint(
+            self, protocol_factory, local_addr, remote_addr, **kwargs
+        )
+
     def run_in_executor(self, executor, func, *args):
         """Simulated ``run_in_executor``: real worker threads are
         forbidden inside a sim (the thread-spawn guard, intercept.py),

@@ -249,6 +249,63 @@ def test_write_after_eof_raises():
     assert run_sim(main) == "ok"
 
 
+def test_raw_datagram_endpoint_over_sim_udp():
+    # stdlib DatagramProtocol classes over the simulated UDP
+    # (loop.create_datagram_endpoint -> net/aio_streams.py)
+    class EchoServer(asyncio.DatagramProtocol):
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            self.transport.sendto(b"echo:" + data, addr)
+
+    class Client(asyncio.DatagramProtocol):
+        def __init__(self):
+            self.got = asyncio.Queue()
+
+        def connection_made(self, transport):
+            self.transport = transport
+
+        def datagram_received(self, data, addr):
+            self.got.put_nowait(data)
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            loop = asyncio.get_running_loop()
+            await loop.create_datagram_endpoint(
+                EchoServer, local_addr=("10.0.0.1", 5300)
+            )
+            await asyncio.sleep(1000)
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            loop = asyncio.get_running_loop()
+            tr, proto = await loop.create_datagram_endpoint(
+                Client,
+                local_addr=("10.0.0.2", 0),
+                remote_addr=("10.0.0.1", 5300),
+            )
+            # connected-socket sendto with a FOREIGN address must raise
+            with pytest.raises(ValueError, match="connected"):
+                tr.sendto(b"x", ("10.9.9.9", 1))
+            out = []
+            for i in range(3):
+                tr.sendto(f"dgram{i}".encode())
+                out.append(await proto.got.get())
+            tr.close()
+            return out
+
+        return await cli.spawn(client())
+
+    out = run_sim(main)
+    assert out == [b"echo:dgram0", b"echo:dgram1", b"echo:dgram2"]
+
+
 def test_unretrieved_task_exception_reported_at_sim_end(capsys):
     async def main():
         async def boom():

@@ -1,0 +1,136 @@
+"""The DST loop end-to-end on an UNMODIFIED asyncio app: seed search
+finds a timing-dependent bug, the banner reproduces it.
+
+The client below has a real bug: it retries a request after a
+connection reset, but only ONCE — if the server's crash window swallows
+both attempts, the request is silently lost. Whether that happens
+depends entirely on the seeded timing of the kill/restart against the
+client's schedule: most seeds pass, some fail. Exactly the class of bug
+deterministic simulation testing exists for (the reference's pitch,
+madsim README):
+
+    python examples/chaos_find_bug.py          # sweep 40 seeds, find one
+    MADSIM_TEST_SEED=<reported> python examples/chaos_find_bug.py --one
+                                               # replay just that seed
+
+The app code is plain stdlib asyncio (open_connection/start_server,
+Queue, sleep) — no simulator imports; only the harness at the bottom
+touches madsim_tpu. Sweeping N seeds takes seconds of wall time because
+all the "seconds" in the app are virtual.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import asyncio
+import os
+import random
+import sys
+
+import madsim_tpu as ms
+
+N_REQS = 6
+
+
+# ----------------------------------------------------------------------
+# The application under test: plain asyncio, one real bug.
+# ----------------------------------------------------------------------
+async def kv_server():
+    store = {}
+
+    async def on_client(reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                key, _, val = line.decode().strip().partition("=")
+                store[key] = val
+                writer.write(f"ok:{key}\n".encode())
+                await writer.drain()
+        except ConnectionError:
+            pass
+
+    server = await asyncio.start_server(on_client, "10.0.0.1", 7100)
+    async with server:
+        await server.serve_forever()
+
+
+async def flaky_client(results: list):
+    """Writes N_REQS keys; on a reset it reconnects and retries the
+    in-flight request — but only once (THE BUG: a second failure of the
+    same request is silently dropped)."""
+
+    async def connect():
+        return await asyncio.open_connection("10.0.0.1", 7100)
+
+    reader, writer = await connect()
+    for i in range(N_REQS):
+        payload = f"k{i}=v{i}\n".encode()
+        for attempt in (1, 2):
+            try:
+                writer.write(payload)
+                await writer.drain()
+                ack = await asyncio.wait_for(reader.readline(), timeout=1.0)
+                if ack:
+                    results.append(i)
+                    break
+                raise ConnectionResetError  # EOF mid-request
+            except (ConnectionError, RuntimeError, TimeoutError):
+                if attempt == 2:
+                    break  # BUG: request i silently lost
+                await asyncio.sleep(0.3)  # BUG: assumes 300 ms is enough
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                try:
+                    reader, writer = await connect()
+                except ConnectionError:
+                    break  # BUG: gives up instead of backing off more
+        await asyncio.sleep(0.05)
+
+
+# ----------------------------------------------------------------------
+# The harness: chaos + invariant. Only this part knows the simulator.
+# ----------------------------------------------------------------------
+@ms.test
+async def main():
+    h = ms.Handle.current()
+    srv = (
+        h.create_node().name("kv").ip("10.0.0.1").init(kv_server).build()
+    )
+    cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+    results: list = []
+    done = cli.spawn(flaky_client(results))
+
+    # chaos: one kill/restart at a seeded moment while requests flow
+    await ms.sleep(random.random() * 0.8)
+    h.kill(srv)
+    await ms.sleep(0.1 + random.random() * 0.5)
+    h.restart(srv)
+
+    await done
+    acked = sorted(results)
+    assert acked == list(range(N_REQS)), (
+        f"LOST REQUESTS: acked only {acked} of {list(range(N_REQS))}"
+    )
+
+
+if __name__ == "__main__":
+    if "--one" in sys.argv:
+        main()
+        print("this seed passes")
+    else:
+        os.environ.setdefault("MADSIM_TEST_NUM", "40")
+        try:
+            main()
+        except BaseException:
+            print(
+                "\nbug found — replay with the banner seed above:\n"
+                "  MADSIM_TEST_SEED=<seed> python examples/chaos_find_bug.py --one",
+                file=sys.stderr,
+            )
+            raise
+        print(f"all {os.environ['MADSIM_TEST_NUM']} seeds passed (unexpected "
+              f"for this buggy client — raise MADSIM_TEST_NUM)")

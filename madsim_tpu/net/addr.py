@@ -35,7 +35,26 @@ def parse_addr(addr: AddrLike) -> SocketAddr:
     raise TypeError(f"cannot parse address from {type(addr).__name__}")
 
 
+def _is_ip_literal(s: str) -> bool:
+    return bool(s) and not any(c.isalpha() for c in s)
+
+
 async def lookup_host(host: AddrLike) -> Iterable[SocketAddr]:
-    """Deterministic hostname resolution (addr.rs:32): returns the single
-    canonical address; never touches real DNS."""
-    return [parse_addr(host)]
+    """Deterministic hostname resolution (addr.rs:32): never touches
+    real DNS. IP literals (plus the localhost aliases) canonicalize;
+    inside a simulation, a non-IP name resolves to the simulated node
+    with that name (the node registry IS the zone file — beyond the
+    reference's alias-only resolver), so services connect by name:
+    ``asyncio.open_connection("kv-server", 7000)``. An unknown name
+    raises OSError like a real resolver."""
+    ip, port = parse_addr(host)
+    if _is_ip_literal(ip):
+        return [(ip, port)]
+    from ..runtime import context
+
+    h = context.try_current_handle()
+    if h is not None:
+        for info in h.executor.nodes.values():
+            if info.name == ip and info.ip:
+                return [(info.ip, port)]
+    raise OSError(f"name resolution failed for {ip!r}")

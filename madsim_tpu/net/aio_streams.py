@@ -24,6 +24,7 @@ import asyncio as _aio
 from typing import Callable, Optional
 
 from ..runtime.task import spawn
+from .addr import lookup_host
 from .tcp import TcpListener, TcpStream
 from .udp import UdpSocket
 
@@ -373,9 +374,11 @@ class SimServer:
 async def create_connection(
     loop, protocol_factory: Callable, host: str, port: int, **kwargs
 ):
-    """``loop.create_connection`` for the sim loop: connect the simulated
-    TCP, adapt via SimTransport, return ``(transport, protocol)``."""
-    stream = await TcpStream.connect((host, port))
+    """``loop.create_connection`` for the sim loop: resolve (node names
+    resolve deterministically, net/addr.py), connect the simulated TCP,
+    adapt via SimTransport, return ``(transport, protocol)``."""
+    addr = next(iter(await lookup_host((host, port))))
+    stream = await TcpStream.connect(addr)
     protocol = protocol_factory()
     tr = SimTransport(loop, stream, protocol)
     tr._start()
@@ -401,7 +404,7 @@ async def create_datagram_endpoint(
     """``loop.create_datagram_endpoint`` for the sim loop."""
     sock = await UdpSocket.bind(local_addr or ("0.0.0.0", 0))
     if remote_addr is not None:
-        await sock.connect(remote_addr)
+        await sock.connect(next(iter(await lookup_host(remote_addr))))
     protocol = protocol_factory()
     tr = SimDatagramTransport(
         loop, sock, protocol, sock.peer_addr

@@ -304,6 +304,19 @@ class SimEventLoop:
             self, protocol_factory, local_addr, remote_addr, **kwargs
         )
 
+    async def getaddrinfo(self, host, port, *, family=0, type=0, proto=0,
+                          flags=0):
+        """Deterministic resolver (net/addr.py lookup_host — simulated
+        node names resolve; no real DNS), in getaddrinfo result shape."""
+        import socket as _socket
+
+        from ..net.addr import lookup_host
+
+        return [
+            (_socket.AF_INET, type or _socket.SOCK_STREAM, proto, "", a)
+            for a in await lookup_host((host, port if port else 0))
+        ]
+
     def run_in_executor(self, executor, func, *args):
         """Simulated ``run_in_executor``: real worker threads are
         forbidden inside a sim (the thread-spawn guard, intercept.py),

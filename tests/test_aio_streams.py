@@ -249,6 +249,45 @@ def test_write_after_eof_raises():
     assert run_sim(main) == "ok"
 
 
+def test_connect_by_node_name():
+    # the node registry is the zone file: raw open_connection by NAME
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                writer.write(b"named\n")
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 7500)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("kv-server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            reader, writer = await asyncio.open_connection("kv-server", 7500)
+            out = await reader.readline()
+            writer.close()
+            # an unknown name fails like a real resolver
+            with pytest.raises(OSError, match="resolution failed"):
+                await asyncio.open_connection("no-such-host", 1)
+            # loop.getaddrinfo resolves too
+            infos = await asyncio.get_running_loop().getaddrinfo(
+                "kv-server", 7500
+            )
+            return out, infos[0][4]
+
+        return await cli.spawn(client())
+
+    out, addr = run_sim(main)
+    assert out == b"named\n"
+    assert addr == ("10.0.0.1", 7500)
+
+
 def test_raw_datagram_endpoint_over_sim_udp():
     # stdlib DatagramProtocol classes over the simulated UDP
     # (loop.create_datagram_endpoint -> net/aio_streams.py)

@@ -297,7 +297,11 @@ class SimServer:
         self._accept_task = None
         self._closed_fut = loop.create_future()
         self._serving_fut = None
-        self._transports: set[SimTransport] = set()
+        # dict-as-ordered-set: a plain set would iterate in address
+        # order, making close_clients()/abort_clients() close
+        # connections in a NONDETERMINISTIC order — the exact class of
+        # hidden nondeterminism this simulator exists to forbid
+        self._transports: dict[SimTransport, None] = {}
 
     @property
     def sockets(self) -> list:
@@ -317,9 +321,9 @@ class SimServer:
             # does not accumulate dead entries for the server's lifetime
             tr = SimTransport(
                 self._loop, stream, protocol,
-                on_lost=self._transports.discard,
+                on_lost=lambda t: self._transports.pop(t, None),
             )
-            self._transports.add(tr)
+            self._transports[tr] = None
             tr._start()
 
     async def start_serving(self) -> None:
@@ -353,11 +357,11 @@ class SimServer:
             self._closed_fut.set_result(None)
 
     def close_clients(self) -> None:
-        for tr in self._transports:
+        for tr in list(self._transports):
             tr.close()
 
     def abort_clients(self) -> None:
-        for tr in self._transports:
+        for tr in list(self._transports):
             tr.abort()
 
     async def wait_closed(self) -> None:

@@ -1,0 +1,14 @@
+"""Put the repo root on sys.path for directly-run example scripts.
+
+``python examples/foo.py`` puts ``examples/`` (the script dir) on the
+path, not the repo root, so ``import madsim_tpu`` fails unless the repo
+is installed or PYTHONPATH is set. Every example imports this module
+first; it resolves because the script dir IS on the path.
+"""
+
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

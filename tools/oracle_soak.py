@@ -1,0 +1,109 @@
+"""Extended oracle soak: bit-identical evidence at many times the suite's
+seed counts.
+
+The default gate compares 8-16 seeds per family against the C++ oracle
+(tests/test_oracle.py). This soak widens that to N seeds per family —
+every field of every seed (trace hash, clock, msg count, halt, final
+node state) — across all 8 protocol families plus the durable
+variants, and prints one verdict line per config. Run it when idle CPU
+is cheap; commit the transcript as the round's soak artifact.
+
+Usage: python tools/oracle_soak.py [n_seeds] > ORACLE_SOAK_rNN.txt
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu.engine import EngineConfig, make_init, make_run  # noqa: E402
+from madsim_tpu.engine.oracle import run_oracle  # noqa: E402
+from madsim_tpu.models import (  # noqa: E402
+    make_broadcast,
+    make_kvchaos,
+    make_microbench,
+    make_paxos,
+    make_pingpong,
+    make_raft,
+    make_raftlog,
+    make_twophase,
+)
+
+# (name, workload factory, engine config, steps, oracle kwargs) — the
+# oracle-suite configurations (tests/test_oracle.py), soaked wider
+CONFIGS = [
+    ("pingpong", lambda: make_pingpong(rounds=5),
+     dict(pool_size=64), 200, dict(rounds=5)),
+    ("microbench", lambda: make_microbench(rounds=200),
+     dict(pool_size=16), 220, dict(rounds=200)),
+    ("raft", make_raft, dict(pool_size=128, loss_p=0.05), 400, {}),
+    ("broadcast", lambda: make_broadcast(rounds=3),
+     dict(pool_size=128, loss_p=0.05), 400, dict(rounds=3)),
+    ("kvchaos", lambda: make_kvchaos(writes=5),
+     dict(pool_size=128, loss_p=0.02), 500, dict(writes=5)),
+    ("kvchaos-payload", lambda: make_kvchaos(writes=5, payload=True),
+     dict(pool_size=128, loss_p=0.02), 500, dict(writes=5)),
+    ("twophase", lambda: make_twophase(txns=4),
+     dict(pool_size=64, loss_p=0.03), 500, dict(txns=4)),
+    ("raftlog", make_raftlog,
+     dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
+     3000, {}),
+    ("raftlog-durable", lambda: make_raftlog(durable=True),
+     dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
+     3000, {}),
+    ("paxos", make_paxos, dict(pool_size=64, loss_p=0.02), 400, {}),
+    ("paxos-durable", lambda: make_paxos(durable_acceptors=True),
+     dict(pool_size=64, loss_p=0.02), 400,
+     dict(durable_acceptors=True)),
+]
+
+FIELDS = ["trace", "now", "msg_count", "halted", "halt_time", "overflow"]
+
+
+def main() -> None:
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    total_bad = 0
+    t_all = time.monotonic()
+    print(f"# oracle soak: {n_seeds} seeds x {len(CONFIGS)} configs, "
+          f"platform={jax.devices()[0].platform}")
+    for name, factory, cfg_kw, steps, okw in CONFIGS:
+        wl, cfg = factory(), EngineConfig(**cfg_kw)
+        seeds = np.arange(n_seeds, dtype=np.uint64)
+        t0 = time.monotonic()
+        out = jax.block_until_ready(
+            jax.jit(make_run(wl, cfg, steps))(make_init(wl, cfg)(seeds))
+        )
+        bad = 0
+        for i, seed in enumerate(seeds):
+            o = run_oracle(wl, cfg, int(seed), steps, **okw)
+            ok = (
+                int(out.trace[i]) == o.trace
+                and int(out.now[i]) == o.now
+                and int(out.msg_count[i]) == o.msg_count
+                and bool(out.halted[i]) == o.halted
+                and int(out.halt_time[i]) == o.halt_time
+                and int(out.overflow[i]) == o.overflow
+                and np.array_equal(np.asarray(out.node_state[i]), o.node_state)
+            )
+            if not ok:
+                bad += 1
+                print(f"  DIVERGED {name} seed={seed}")
+        total_bad += bad
+        verdict = "IDENTICAL" if bad == 0 else f"{bad} DIVERGED"
+        print(f"{name}: {n_seeds} seeds {verdict} "
+              f"({time.monotonic() - t0:.1f}s)")
+    print(f"# total divergences: {total_bad} "
+          f"({time.monotonic() - t_all:.0f}s wall)")
+    sys.exit(1 if total_bad else 0)
+
+
+if __name__ == "__main__":
+    main()

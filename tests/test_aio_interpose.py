@@ -206,6 +206,31 @@ def test_raw_asyncio_is_deterministic():
     assert a != c, "different seed must schedule differently"
 
 
+def test_raw_barrier():
+    # asyncio.Barrier (3.11+): parties rendezvous on virtual time
+    async def main():
+        b = asyncio.Barrier(3)
+        order = []
+
+        async def party(i):
+            await asyncio.sleep(0.01 * i)
+            await b.wait()
+            order.append((i, ms.now_ns()))
+
+        async with asyncio.TaskGroup() as tg:
+            for i in range(3):
+                tg.create_task(party(i))
+        return order
+
+    order = run_sim(main)
+    assert sorted(i for i, _t in order) == [0, 1, 2]
+    # all three released at the same virtual instant window (after the
+    # slowest arrival at ~0.02s)
+    times = [t for _i, t in order]
+    assert min(times) >= 20_000_000
+    assert max(times) - min(times) < 1_000_000
+
+
 def test_fuzzed_raw_asyncio_is_deterministic():
     """The race-detector analog for the interposition layer: a RANDOM
     program of raw-asyncio primitives (queues, sleeps, timeouts,

@@ -165,6 +165,41 @@ def test_server_node_kill_resets_client_stream():
     assert rest == b""
 
 
+def test_readexactly_and_readuntil():
+    # the rest of the StreamReader surface over the simulated TCP
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            async def on_client(reader, writer):
+                writer.write(b"HDR|12345678world")
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(on_client, "10.0.0.1", 9600)
+            async with server:
+                await server.serve_forever()
+
+        h.create_node().name("server").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.02)
+            reader, writer = await asyncio.open_connection("10.0.0.1", 9600)
+            hdr = await reader.readuntil(b"|")
+            body = await reader.readexactly(8)
+            rest = await reader.read()
+            with pytest.raises(asyncio.IncompleteReadError):
+                await reader.readexactly(5)  # stream already at EOF
+            writer.close()
+            return hdr, body, rest
+
+        return await cli.spawn(client())
+
+    hdr, body, rest = run_sim(main)
+    assert (hdr, body, rest) == (b"HDR|", b"12345678", b"world")
+
+
 def test_half_close_request_response():
     # write_eof as the request delimiter: the server reads to EOF, then
     # RESPONDS over the still-open write side (eof_received() -> True

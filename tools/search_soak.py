@@ -1,0 +1,131 @@
+"""Large-scale chaos-schedule search soak: safety certificates.
+
+The suite proves each protocol family's invariant over ~1k schedules;
+this soak sweeps MANY more through `engine.search_seeds` (the batched
+chaos search, compacted path) with fully vectorized invariants and
+prints one certificate line per family: seeds searched, violations,
+overflows, unhalted. A clean run is a negative-result artifact — "no
+safety violation exists in the first N seeds" — exactly what the
+reference's multi-seed harness produces one process per seed at a
+time, here as a handful of XLA dispatches.
+
+Usage: python tools/search_soak.py [n_seeds] > SEARCH_r05.txt
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
+from madsim_tpu.models import make_paxos, make_raft, make_raftlog  # noqa: E402
+from madsim_tpu.models.paxos import A_VAL, P_DEC  # noqa: E402
+from madsim_tpu.models.raft import LEADER as R_LEADER  # noqa: E402
+from madsim_tpu.models.raft import ROLE as R_ROLE  # noqa: E402
+from madsim_tpu.models.raft import TERM as R_TERM  # noqa: E402
+from madsim_tpu.models.raftlog import (  # noqa: E402
+    COMMIT,
+    LOG0,
+    LOGLEN,
+)
+
+W = 4  # raftlog n_writes (the default the invariant is written for)
+
+
+def raftlog_majority_prefix(view) -> np.ndarray:
+    """Every committed entry present, in order, equal values, on a
+    majority (the suite's TestRaftLog assertion, vectorized)."""
+    ns = np.asarray(view["node_state"])  # (S, 5, U)
+    committed = ns[:, :, COMMIT] == W  # (S, 5)
+    has_committer = committed.any(axis=1)
+    first = np.argmax(committed, axis=1)  # index of a committer
+    vals = ns[:, :, LOG0:LOG0 + W] & 0xFF  # (S, 5, W)
+    ref = vals[np.arange(ns.shape[0]), first]  # (S, W)
+    long_enough = ns[:, :, LOGLEN] >= W
+    match = long_enough & (vals == ref[:, None, :]).all(axis=2)
+    return has_committer & (match.sum(axis=1) >= 3)
+
+
+def raft_single_leader(view) -> np.ndarray:
+    """At most one leader per term at halt (election safety)."""
+    ns = np.asarray(view["node_state"])  # (S, 5, U)
+    is_leader = ns[:, :, R_ROLE] == R_LEADER
+    term = ns[:, :, R_TERM]
+    ok = np.ones(ns.shape[0], dtype=bool)
+    # leaders sharing a term within a seed would violate election safety
+    for s in np.nonzero(is_leader.sum(axis=1) > 1)[0]:
+        terms = term[s][is_leader[s]]
+        ok[s] = len(np.unique(terms)) == len(terms)
+    # the north-star workload halts when a leader exists
+    return ok & is_leader.any(axis=1)
+
+
+def paxos_agreement(view) -> np.ndarray:
+    """Agreement + validity + acceptor-majority witness (the suite's
+    paxos assertion, vectorized). 5 acceptors, 3 proposers."""
+    a, p = 5, 3
+    ns = np.asarray(view["node_state"])
+    dec = ns[:, a:, P_DEC]  # (S, 3)
+    acc = ns[:, :a, A_VAL]  # (S, 5)
+    decided = dec != 0
+    some = decided.any(axis=1)
+    first = np.argmax(decided, axis=1)
+    v = dec[np.arange(ns.shape[0]), first]
+    agree = np.where(decided, dec == v[:, None], True).all(axis=1)
+    valid = (v >= 1) & (v <= p)
+    witness = (acc == v[:, None]).sum(axis=1) >= a // 2 + 1
+    return some & agree & valid & witness
+
+
+SOAKS = [
+    ("raft-election", make_raft,
+     dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
+     600, raft_single_leader),
+    ("raftlog", make_raftlog,
+     dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
+     4000, raftlog_majority_prefix),
+    ("raftlog-durable", lambda: make_raftlog(durable=True),
+     dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
+     4000, raftlog_majority_prefix),
+    ("paxos", make_paxos, dict(pool_size=64, loss_p=0.02), 2000,
+     paxos_agreement),
+    ("paxos-durable", lambda: make_paxos(durable_acceptors=True),
+     dict(pool_size=64, loss_p=0.02), 2000, paxos_agreement),
+]
+
+
+def main() -> None:
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    t_all = time.monotonic()
+    worst = 0
+    print(f"# chaos-search soak: {n_seeds} schedules/family, "
+          f"platform={jax.devices()[0].platform}")
+    for name, factory, cfg_kw, steps, inv in SOAKS:
+        t0 = time.monotonic()
+        rep = search_seeds(
+            factory(), EngineConfig(**cfg_kw), inv,
+            n_seeds=n_seeds, max_steps=steps, compact=True,
+        )
+        nv = int(rep.failing_seeds.size)
+        no = int(rep.overflowed.sum())
+        nh = int((~np.asarray(rep.halted)).sum())
+        worst = max(worst, nv)
+        print(f"{name}: {n_seeds} schedules, {nv} violations, "
+              f"{no} overflows, {nh} unhalted "
+              f"({time.monotonic() - t0:.1f}s)")
+        if nv:
+            print(f"  first failing seeds: {rep.failing_seeds[:5].tolist()}")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    sys.exit(1 if worst else 0)
+
+
+if __name__ == "__main__":
+    main()

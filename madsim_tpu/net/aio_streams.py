@@ -24,7 +24,7 @@ import asyncio as _aio
 from typing import Callable, Optional
 
 from ..runtime.task import spawn
-from .addr import lookup_host
+from .addr import lookup_host, parse_addr
 from .tcp import TcpListener, TcpStream
 from .udp import UdpSocket
 
@@ -219,8 +219,12 @@ class SimDatagramTransport:
         self._pumps.append(spawn(self._send_pump(), name="udp-send-pump"))
 
     async def _recv_pump(self) -> None:
-        while not self._closed:
+        # stop on _closing too: asyncio removes the reader the moment
+        # close() is called, even while queued sends still flush
+        while not (self._closing or self._closed):
             data, src = await self._sock.recv_from()
+            if self._closing or self._closed:
+                return
             if self._remote is not None and src != self._remote:
                 continue  # connected-socket filter (udp.py recv parity)
             self._protocol.datagram_received(data, src)
@@ -236,7 +240,7 @@ class SimDatagramTransport:
             data, addr = self._send_q.pop(0)
             try:
                 await self._sock.send_to(data, addr)
-            except OSError as exc:
+            except (OSError, ValueError, TypeError) as exc:
                 # datagram semantics: per-packet error, transport lives
                 self._protocol.error_received(exc)
 
@@ -267,10 +271,15 @@ class SimDatagramTransport:
             if self._remote is None:
                 raise ValueError("no address given and socket not connected")
             addr = self._remote
-        elif self._remote is not None and tuple(addr) != tuple(self._remote):
-            raise ValueError(
-                f"invalid address: must be {self._remote} (connected socket)"
-            )
+        else:
+            # validate at the CALL SITE (a malformed addr surfacing later
+            # in the send pump would fail the whole sim far from the bug)
+            addr = parse_addr(addr)
+            if self._remote is not None and addr != tuple(self._remote):
+                raise ValueError(
+                    f"invalid address: must be {self._remote} "
+                    f"(connected socket)"
+                )
         self._send_q.append((bytes(data), addr))
         self._send_wake.set()
 
@@ -407,8 +416,15 @@ async def create_datagram_endpoint(
 ):
     """``loop.create_datagram_endpoint`` for the sim loop."""
     sock = await UdpSocket.bind(local_addr or ("0.0.0.0", 0))
-    if remote_addr is not None:
-        await sock.connect(next(iter(await lookup_host(remote_addr))))
+    try:
+        if remote_addr is not None:
+            await sock.connect(next(iter(await lookup_host(remote_addr))))
+    except BaseException:
+        # the bind succeeded: release the port or a retry on the same
+        # local_addr fails with address-already-in-use for the rest of
+        # the sim
+        sock.close()
+        raise
     protocol = protocol_factory()
     tr = SimDatagramTransport(
         loop, sock, protocol, sock.peer_addr

@@ -312,9 +312,12 @@ class SimEventLoop:
 
         from ..net.addr import lookup_host
 
+        # host=None is the stdlib idiom for the wildcard address
         return [
             (_socket.AF_INET, type or _socket.SOCK_STREAM, proto, "", a)
-            for a in await lookup_host((host, port if port else 0))
+            for a in await lookup_host(
+                ("" if host is None else host, port if port else 0)
+            )
         ]
 
     def run_in_executor(self, executor, func, *args):

@@ -434,6 +434,49 @@ def test_raw_datagram_endpoint_over_sim_udp():
     assert out == [b"echo:dgram0", b"echo:dgram1", b"echo:dgram2"]
 
 
+def test_datagram_endpoint_failed_resolve_releases_port():
+    async def main():
+        loop = asyncio.get_running_loop()
+        with pytest.raises(OSError, match="resolution failed"):
+            await loop.create_datagram_endpoint(
+                asyncio.DatagramProtocol,
+                local_addr=("10.0.0.1", 5555),
+                remote_addr=("no-such-host", 1),
+            )
+        # the bind must have been released: same port works again
+        tr, _p = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("10.0.0.1", 5555)
+        )
+        tr.close()
+        return "ok"
+
+    assert run_sim(main) == "ok"
+
+
+def test_datagram_sendto_validates_at_call_site():
+    async def main():
+        loop = asyncio.get_running_loop()
+        tr, _p = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("10.0.0.1", 5600)
+        )
+        # malformed addr raises HERE, not in the background pump (where
+        # it would fail the whole sim far from the bug)
+        with pytest.raises(ValueError):
+            tr.sendto(b"x", "10.0.0.2")  # no port
+        tr.close()
+        return "ok"
+
+    assert run_sim(main) == "ok"
+
+
+def test_getaddrinfo_none_host_is_wildcard():
+    async def main():
+        infos = await asyncio.get_running_loop().getaddrinfo(None, 8080)
+        return infos[0][4]
+
+    assert run_sim(main) == ("0.0.0.0", 8080)
+
+
 def test_unretrieved_task_exception_reported_at_sim_end(capsys):
     async def main():
         async def boom():

@@ -65,9 +65,6 @@ CONFIGS = [
      dict(durable_acceptors=True)),
 ]
 
-FIELDS = ["trace", "now", "msg_count", "halted", "halt_time", "overflow"]
-
-
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     total_bad = 0

@@ -117,7 +117,9 @@ def main() -> None:
         nv = int(rep.failing_seeds.size)
         no = int(rep.overflowed.sum())
         nh = int((~np.asarray(rep.halted)).sum())
-        worst = max(worst, nv)
+        # an overflowed or unhalted schedule was NOT fully verified — a
+        # certificate must refuse, not silently count it as searched
+        worst = max(worst, nv, no, nh)
         print(f"{name}: {n_seeds} schedules, {nv} violations, "
               f"{no} overflows, {nh} unhalted "
               f"({time.monotonic() - t0:.1f}s)")

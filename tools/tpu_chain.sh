@@ -16,6 +16,31 @@ esac
 MARK="/tmp/tpu_chain_${STAMP}"
 fail=0
 
+# Step 0 — the headline cell alone, FIRST: raft @65,536 seeds through
+# the sized-dispatch harness (~3-5 min incl. compile). The tunnel
+# historically survives ~15 min after recovering; the full bench below
+# needs ~25. Banking this one cell first guarantees the single number
+# three rounds of verdicts have asked for even if the tunnel dies
+# minutes later.
+if [ -f "RAFT_TPU_${STAMP}.json" ]; then
+  echo "$(date -u +%H:%M:%S) chain: raft headline already banked, skipping" >&2
+else
+  echo "$(date -u +%H:%M:%S) chain: raft headline cell" >&2
+  if BENCH_CHILD=raft BENCH_PLATFORM=default BENCH_SEEDS=65536 \
+     BENCH_STEPS=600 timeout 600 python bench.py \
+     > "RAFT_TPU_${STAMP}.json.tmp" 2>> /tmp/bench_watch.err \
+     && tail -1 "RAFT_TPU_${STAMP}.json.tmp" | grep -q '"value"' \
+     && ! tail -1 "RAFT_TPU_${STAMP}.json.tmp" | grep -q '"platform": "cpu"'; then
+    mv "RAFT_TPU_${STAMP}.json.tmp" "RAFT_TPU_${STAMP}.json"
+    echo "$(date -u +%H:%M:%S) chain: raft headline banked:" >&2
+    tail -1 "RAFT_TPU_${STAMP}.json" >&2
+  else
+    rm -f "RAFT_TPU_${STAMP}.json.tmp"
+    echo "$(date -u +%H:%M:%S) chain: raft headline failed/degraded, aborting chain" >&2
+    exit 1
+  fi
+fi
+
 if [ -f "BENCH_TPU_${STAMP}.jsonl" ]; then
   echo "$(date -u +%H:%M:%S) chain: bench already banked, skipping" >&2
 else

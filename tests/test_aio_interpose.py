@@ -520,6 +520,50 @@ def test_raw_timeout_at_uses_loop_clock():
     assert run_sim(main) < 1_000_000_000
 
 
+def test_raw_asyncio_composes_with_service_shims():
+    # the gRPC service sim driven through raw-asyncio constructs: a
+    # TaskGroup of concurrent unary calls under asyncio.timeout — sim
+    # futures (the service shim's internals) and asyncio futures mix
+    # freely inside one coroutine tree
+    from madsim_tpu.services import grpc
+
+    class Greeter:
+        SERVICE_NAME = "helloworld.Greeter"
+
+        async def say_hello(self, request):
+            return {"message": f"Hello {request.message['name']}!"}
+
+    async def main():
+        h = ms.Handle.current()
+
+        async def serve():
+            await grpc.Server.builder().add_service(Greeter()).serve(
+                "0.0.0.0:50051"
+            )
+
+        h.create_node().name("grpc").ip("10.0.0.1").init(serve).build()
+        cli = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def client():
+            await asyncio.sleep(0.1)
+            ch = await grpc.connect("10.0.0.1:50051")
+            c = grpc.service_client(Greeter, ch)
+
+            async def one(i):
+                async with asyncio.timeout(5.0):
+                    r = await c.say_hello({"name": f"n{i}"})
+                    return r["message"]
+
+            async with asyncio.TaskGroup() as tg:
+                ts = [tg.create_task(one(i)) for i in range(4)]
+            return sorted(t.result() for t in ts)
+
+        return await cli.spawn(client())
+
+    out = run_sim(main)
+    assert out == [f"Hello n{i}!" for i in range(4)]
+
+
 def test_raw_asyncio_with_chaos_kill():
     # raw-asyncio code on a killed node: its tasks die with the node
     async def main():

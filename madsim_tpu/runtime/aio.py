@@ -382,6 +382,58 @@ def exit_poll(loop: SimEventLoop, task, prev) -> None:
     _set_running_loop(prev)
 
 
+def deterministic_as_completed(fs, *, timeout: Optional[float] = None):
+    """Replacement for ``asyncio.as_completed`` inside simulations.
+
+    CPython's implementation dedups the inputs through ``set(fs)`` and
+    spawns them while iterating that set — i.e. in MEMORY-ADDRESS
+    order, which consumes scheduling RNG in a different order on every
+    replay. The determinism checker (MADSIM_TEST_CHECK_DETERMINISM)
+    caught this as a genuine op-stream divergence, so the interposition
+    layer (runtime/intercept.py) swaps in this version during sims:
+    identical semantics — dedup by identity, completion-ordered
+    awaitables, TimeoutError after ``timeout`` — but tasks spawn in
+    INPUT order.
+    """
+    loop = _aio.events.get_running_loop()
+    seen: set = set()
+    todo: list = []
+    for f in fs:
+        if id(f) in seen:
+            continue
+        seen.add(id(f))
+        todo.append(_aio.ensure_future(f, loop=loop))
+    done: _aio.Queue = _aio.Queue()
+    timeout_handle = None
+
+    def _on_timeout():
+        for f in todo:
+            f.remove_done_callback(_on_completion)
+            done.put_nowait(None)  # wake every waiter with TimeoutError
+        todo.clear()
+
+    def _on_completion(f):
+        if not todo:
+            return  # timeout already fired
+        todo.remove(f)
+        done.put_nowait(f)
+        if timeout_handle is not None and not todo:
+            timeout_handle.cancel()
+
+    async def _wait_for_one():
+        f = await done.get()
+        if f is None:
+            raise TimeoutError
+        return f.result()
+
+    for f in todo:
+        f.add_done_callback(_on_completion)
+    if todo and timeout is not None:
+        timeout_handle = loop.call_later(timeout, _on_timeout)
+    for _ in range(len(todo)):
+        yield _wait_for_one()
+
+
 def is_asyncio_future(obj: Any) -> bool:
     """The ``isfuture`` protocol check (asyncio.futures.isfuture):
     anything with ``_asyncio_future_blocking`` is awaited the asyncio

@@ -190,6 +190,26 @@ def install() -> None:
 
     _time_mod.sleep = t_sleep
 
+    # --- asyncio.as_completed: the ONE stdlib asyncio API whose spawn
+    # order is memory-address-dependent (it dedups through set(fs));
+    # inside a sim it must spawn in input order or replays diverge —
+    # caught by the determinism checker. Everything else in asyncio
+    # runs unmodified through the loop interposition (runtime/aio.py).
+    import asyncio as _aio_mod
+
+    orig_as_completed = _aio_mod.as_completed
+    _originals[("asyncio", "as_completed")] = orig_as_completed
+
+    def as_completed(fs, *, timeout=None):
+        if context.in_simulation():
+            from . import aio as _aio_impl
+
+            return _aio_impl.deterministic_as_completed(fs, timeout=timeout)
+        return orig_as_completed(fs, timeout=timeout)
+
+    _aio_mod.as_completed = as_completed
+    _aio_mod.tasks.as_completed = as_completed
+
     # --- forbid real threads inside the sim (task.rs:711-725) -----------
     orig_start = threading.Thread.start
     _originals[("threading", "start")] = orig_start

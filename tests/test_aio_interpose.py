@@ -10,6 +10,7 @@ cancellation semantics, and non-interference with real asyncio.
 """
 
 import asyncio
+import os
 
 import pytest
 
@@ -21,6 +22,9 @@ def run_sim(workload, seed=7):
     b = Builder()
     b.seed = seed
     b.count = 1
+    # honor the determinism re-check tier (make determinism): every
+    # raw-asyncio workload replays under the RNG-op-log checker too
+    b.check_determinism = bool(os.environ.get("MADSIM_TEST_CHECK_DETERMINISM"))
     return b.run(workload)
 
 
@@ -495,6 +499,27 @@ def test_raw_as_completed_orders_by_virtual_time():
         return results
 
     assert run_sim(main) == [0, 1, 2]
+
+
+def test_raw_as_completed_timeout():
+    # the sim's deterministic as_completed (runtime/aio.py — stdlib's
+    # spawns in set order, which diverges on replay): remaining waiters
+    # raise TimeoutError after the deadline, finished ones still yield
+    async def main():
+        async def job(d):
+            await asyncio.sleep(d)
+            return d
+
+        got, timed_out = [], 0
+        for fut in asyncio.as_completed([job(0.01), job(5.0)], timeout=0.1):
+            try:
+                got.append(await fut)
+            except TimeoutError:
+                timed_out += 1
+        return got, timed_out
+
+    got, timed_out = run_sim(main)
+    assert got == [0.01] and timed_out == 1
 
 
 def test_raw_wait_for_over_sim_native_awaitable():

@@ -178,32 +178,60 @@ def parent() -> None:
             if final_mode == "default":
                 platform = retry_platform
 
+    print(json.dumps(summary_dict(results, platform)), flush=True)
+
+
+def summary_dict(results: dict, platform: str) -> dict:
+    """The one parent summary line every bench artifact ends with —
+    consumers ``tail -1`` for the headline (raft) value + vs_baseline.
+    Shared by the live parent sweep and the row-assembly mode so
+    chain-assembled artifacts carry the identical schema."""
     head = results.get("raft")
     value = float(head["value"]) if head else 0.0
-    n_seeds = int(head["n_seeds"]) if head else 0
-    print(
-        json.dumps(
-            {
-                "metric": "sim_seconds_per_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "sim_s/s/chip",
-                "vs_baseline": round(value / TARGET, 4),
-                "platform": head.get("platform", platform) if head else platform,
-                "n_seeds": n_seeds,
-                "configs": {
-                    k: {
-                        "value": v["value"],
-                        "unit": v.get("unit", "sim_s/s/chip"),
-                        "n_seeds": v["n_seeds"],
-                        "platform": v.get("platform", platform),
-                        "spread_pct": v.get("spread_pct"),
-                    }
-                    for k, v in results.items()
-                },
+    return {
+        "metric": "sim_seconds_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "sim_s/s/chip",
+        "vs_baseline": round(value / TARGET, 4),
+        "platform": head.get("platform", platform) if head else platform,
+        "n_seeds": int(head["n_seeds"]) if head else 0,
+        "configs": {
+            k: {
+                "value": v["value"],
+                "unit": v.get("unit", "sim_s/s/chip"),
+                "n_seeds": v["n_seeds"],
+                "platform": v.get("platform", platform),
+                "spread_pct": v.get("spread_pct"),
             }
-        ),
-        flush=True,
-    )
+            for k, v in results.items()
+        },
+    }
+
+
+def assemble(row_paths: str) -> None:
+    """BENCH_ASSEMBLE mode: build a full-bench artifact from per-config
+    row files banked by tools/tpu_chain.sh (name=path,name=path,...).
+    Emits the child rows in CONFIGS order, then the parent summary
+    line, to stdout."""
+    paths = dict(item.split("=", 1) for item in row_paths.split(","))
+    unknown = set(paths) - set(CONFIGS)
+    if unknown:
+        raise SystemExit(f"BENCH_ASSEMBLE: unknown configs {sorted(unknown)}")
+    missing = set(CONFIGS) - set(paths)
+    if missing:
+        raise SystemExit(f"BENCH_ASSEMBLE: missing configs {sorted(missing)}")
+    results = {}
+    for name in CONFIGS:
+        with open(paths[name]) as f:
+            row = json.loads(f.read().strip().splitlines()[-1])
+        if row.get("config") != name:
+            raise SystemExit(
+                f"BENCH_ASSEMBLE: {paths[name]} holds config "
+                f"{row.get('config')!r}, expected {name!r}"
+            )
+        results[name] = row
+        print(json.dumps(row))
+    print(json.dumps(summary_dict(results, results["raft"]["platform"])), flush=True)
 
 
 # ---------------------------------------------------------------- child
@@ -364,6 +392,10 @@ def child(config: str) -> None:
 
 
 def main() -> None:
+    rows = os.environ.get("BENCH_ASSEMBLE")
+    if rows:
+        assemble(rows)
+        return
     config = os.environ.get("BENCH_CHILD")
     if config:
         child(config)

@@ -90,28 +90,41 @@ class SimFuture:
         return self._result
 
 
-def select(*futures: SimFuture) -> SimFuture:
-    """Future resolving to ``(index, future)`` of the first completed input.
+def _as_future(f) -> SimFuture:
+    """Accept a SimFuture or anything wrapping one (JoinHandle's _fut) —
+    tokio's combinators take JoinHandles because JoinHandle: Future;
+    the duck-typed unwrap is the analog (task.rs:569-609)."""
+    return f if isinstance(f, SimFuture) else getattr(f, "_fut", f)
+
+
+def select(*futures) -> SimFuture:
+    """Future resolving to ``(index, input)`` of the first completed input.
 
     The deterministic analog of ``tokio::select!`` / ``futures::select``.
+    Accepts SimFutures or spawn() JoinHandles; the winner is returned
+    AS PASSED (a JoinHandle input resolves to that JoinHandle, so e.g.
+    ``loser.abort()`` / identity checks against the inputs work).
     """
     out = SimFuture(name="select")
 
-    def mk(i: int, f: SimFuture) -> Callable[[], None]:
+    def mk(i: int, orig) -> Callable[[], None]:
         def on_done() -> None:
             if not out._done:
-                out.set_result((i, f))
+                out.set_result((i, orig))
 
         return on_done
 
-    for i, f in enumerate(futures):
-        f.add_waker(mk(i, f))
+    for i, orig in enumerate(futures):
+        _as_future(orig).add_waker(mk(i, orig))
     return out
 
 
-def join_all(futures: Iterable[SimFuture]) -> SimFuture:
-    """Future resolving to the list of all results (analog of join_all)."""
-    futs = list(futures)
+def join_all(futures: Iterable) -> SimFuture:
+    """Future resolving to the list of all results (analog of join_all).
+
+    Accepts SimFutures or spawn() JoinHandles, like tokio's join_all
+    over JoinHandles (JoinHandle: Future)."""
+    futs = [_as_future(f) for f in futures]
     out = SimFuture(name="join_all")
     remaining = len(futs)
     if remaining == 0:

@@ -286,8 +286,12 @@ def test_select_and_join_all():
             await ms.sleep(0.1)
             return x
 
-        r = await ms.join_all([ms.spawn(val(i))._fut for i in range(5)])
+        # JoinHandles directly (tokio join_all-over-handles parity) …
+        r = await ms.join_all([ms.spawn(val(i)) for i in range(5)])
         assert r == [0, 1, 2, 3, 4]
+        # … and select over a handle/future mix
+        idx2, _ = await ms.select(ms.spawn(val("slowish")), ms.sleep(0.01))
+        assert idx2 == 1
         return True
 
     assert ms.Runtime(seed=6).block_on(main())

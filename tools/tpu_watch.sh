@@ -6,7 +6,7 @@
 # headline bench itself degraded to CPU the watch resumes.
 # Usage: tools/tpu_watch.sh [stamp] [probe_interval_s] [probe_timeout_s]
 set -u
-STAMP="${1:-r04}"
+STAMP="${1:-r05}"
 case "$STAMP" in
   *.jsonl|*/*) echo "usage: tpu_watch.sh [stamp] — got a path: $STAMP" >&2; exit 2 ;;
 esac

@@ -72,20 +72,39 @@ class Handle:
         return self.sims[cls]  # type: ignore[return-value]
 
     # -- chaos API (mod.rs:242-263) --------------------------------------
-    @staticmethod
-    def _node_id(node: "int | NodeHandle") -> int:
-        return node.id if isinstance(node, NodeHandle) else node
+    def _node_id(self, node: "int | str | NodeHandle") -> int:
+        """Resolve a node id, handle, or name — the ToNodeId analog
+        (task.rs:366-397; unknown names raise like the reference's
+        panic)."""
+        if isinstance(node, NodeHandle):
+            return node.id
+        if isinstance(node, str):
+            for nid, info in self.executor.nodes.items():
+                if info.name == node:
+                    return nid
+            raise LookupError(f"node not found: {node}")
+        return node
 
-    def kill(self, node: "int | NodeHandle") -> None:
+    def get_node(self, node: "int | str | NodeHandle") -> "Optional[NodeHandle]":
+        """Look up a live node by id/name/handle (mod.rs:271-273)."""
+        try:
+            nid = self._node_id(node)
+        except LookupError:
+            return None
+        if nid not in self.executor.nodes:
+            return None
+        return NodeHandle(nid, self)
+
+    def kill(self, node: "int | str | NodeHandle") -> None:
         self.executor.kill_node(self._node_id(node))
 
-    def restart(self, node: "int | NodeHandle") -> None:
+    def restart(self, node: "int | str | NodeHandle") -> None:
         self.executor.restart_node(self._node_id(node))
 
-    def pause(self, node: "int | NodeHandle") -> None:
+    def pause(self, node: "int | str | NodeHandle") -> None:
         self.executor.pause_node(self._node_id(node))
 
-    def resume(self, node: "int | NodeHandle") -> None:
+    def resume(self, node: "int | str | NodeHandle") -> None:
         self.executor.resume_node(self._node_id(node))
 
     def create_node(self) -> "NodeBuilder":

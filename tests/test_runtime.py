@@ -297,6 +297,41 @@ def test_select_and_join_all():
     assert ms.Runtime(seed=6).block_on(main())
 
 
+def test_get_node_and_name_resolution():
+    """ToNodeId analog: chaos APIs take ids, handles, or names
+    (task.rs:366-397), and get_node looks nodes up (mod.rs:271)."""
+    async def main():
+        h = ms.Handle.current()
+        n = h.create_node().name("worker-a").ip("10.0.0.5").build()
+        assert h.get_node("worker-a").id == n.id
+        assert h.get_node(n.id).name == "worker-a"
+        assert h.get_node(n).ip == "10.0.0.5"
+        assert h.get_node("absent") is None
+        ticks = []
+
+        async def loop():
+            while True:
+                await ms.sleep(0.1)
+                ticks.append(ms.now_ns())
+
+        n.spawn(loop())
+        await ms.sleep(0.55)
+        h.pause("worker-a")          # chaos by name
+        frozen = len(ticks)
+        await ms.sleep(0.5)
+        assert len(ticks) == frozen
+        h.resume("worker-a")
+        await ms.sleep(0.5)
+        assert len(ticks) > frozen
+        try:
+            h.kill("absent")
+        except LookupError:
+            return True
+        raise AssertionError("kill of unknown name must raise")
+
+    assert ms.Runtime(seed=9).block_on(main())
+
+
 def test_check_determinism_passes_for_deterministic_workload():
     async def wl():
         for _ in range(5):

@@ -136,6 +136,11 @@ if [ ! -f "BENCH_TPU_${STAMP}.jsonl" ]; then
   fi
 fi
 
+# (A raft@262,144 "bonus" cell was considered here and dropped: the
+# scaling sweep below already measures that exact cell with the same
+# sized-dispatch instrument, and an extra 600 s step ahead of the
+# unbanked artifacts would contradict highest-value-first ordering.)
+
 # ---- Step 3: scaling sweep. A step is banked only if its marker AND
 # artifact exist AND the artifact really ran on the accelerator.
 if [ -f "${MARK}.sweep.done" ] && [ -f "SWEEP_TPU_${STAMP}.jsonl" ] \

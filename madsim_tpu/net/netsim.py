@@ -133,6 +133,11 @@ class NetSim(Simulator):
         self.network = Network(rng, config.net)
         self._send_hooks: dict[int, Callable] = {}
         self._next_hook_id = 0
+        # typed RPC hooks, one per node like the reference's HashMap
+        # (mod.rs:82-83): req keyed by SENDING node, consulted at send;
+        # rsp keyed by DESTINATION node, consulted at delivery
+        self._hooks_req: dict[int, Callable[[object], bool]] = {}
+        self._hooks_rsp: dict[int, Callable[[object], bool]] = {}
         # pipes registered per node id — closed when the node resets,
         # deregistered when they close (no growth across connection churn)
         self._pipes_by_node: dict[int, set[Pipe]] = {}
@@ -188,6 +193,104 @@ class NetSim(Simulator):
     def unclog_link_one_way(self, src, dst) -> None:
         self.network.unclog_link(self._nid(src), self._nid(dst))
 
+    def update_config(self, f: Callable) -> None:
+        """Mutate the live network config (mod.rs:131-136) — e.g.
+        ``netsim.update_config(lambda c: setattr(c, "packet_loss_rate",
+        0.2))``; the fault model reads it per send, so changes apply to
+        every subsequent message."""
+        f(self.network.config)
+
+    def clog_node_in(self, node) -> None:
+        """Block messages TO the node; its own sends still flow
+        (mod.rs:183-186)."""
+        self.network.clog_node_in(self._nid(node))
+
+    def unclog_node_in(self, node) -> None:
+        self.network.unclog_node_in(self._nid(node))
+
+    def clog_node_out(self, node) -> None:
+        """Block messages FROM the node; deliveries to it still flow
+        (mod.rs:188-192)."""
+        self.network.clog_node_out(self._nid(node))
+
+    def unclog_node_out(self, node) -> None:
+        self.network.unclog_node_out(self._nid(node))
+
+    # naming-parity aliases (mod.rs:152-213): connect/disconnect are the
+    # reference's names for unclog/clog of a node, connect2/disconnect2
+    # for a link (both directions)
+    def connect(self, node) -> None:
+        self.unclog_node(node)
+
+    def disconnect(self, node) -> None:
+        self.clog_node(node)
+
+    def connect2(self, a, b) -> None:
+        self.unclog_link(a, b)
+
+    def disconnect2(self, a, b) -> None:
+        self.clog_link(a, b)
+
+    def _install_typed_hook(
+        self, hooks: dict, node, typ: type, f, is_rsp: bool, kind: str
+    ) -> None:
+        """Shared body of hook_rpc_req/hook_rpc_rsp: one hook per node
+        (insert overwrites, None removes — the reference's HashMap
+        insert, mod.rs:228/251). RPC frames are discriminated by the
+        bit-63 response-tag invariant rpc.py guarantees (rpc.py:48):
+        requests are ("dgram", req_tag, (obj, data, resp_tag&bit63)),
+        responses are ("dgram", resp_tag&bit63, (obj, data)) — plain
+        same-shape datagrams never match."""
+        nid = self._nid(node)
+        if f is None:
+            hooks.pop(nid, None)
+            return
+
+        def hook(msg: object) -> bool:
+            if not (isinstance(msg, tuple) and len(msg) == 3 and msg[0] == "dgram"):
+                return True
+            tag, payload = msg[1], msg[2]
+            if is_rsp:
+                is_frame = (
+                    isinstance(tag, int) and tag >> 63
+                    and isinstance(payload, tuple) and len(payload) == 2
+                )
+            else:
+                is_frame = (
+                    isinstance(payload, tuple) and len(payload) == 3
+                    and isinstance(payload[2], int) and payload[2] >> 63
+                )
+            if is_frame and isinstance(payload[0], typ):
+                try:
+                    return bool(f(payload[0]))
+                except Exception as exc:
+                    # attribute a raising hook clearly (a rsp hook runs
+                    # inside the delivery timer, outside any task)
+                    raise RuntimeError(f"{kind} hook raised: {exc!r}") from exc
+            return True
+
+        hooks[nid] = hook
+
+    def hook_rpc_req(self, node, req_type: type, f: Callable) -> None:
+        """Install THE request hook for ``node`` (one per node, insert
+        overwrites — mod.rs:223-240): RPC requests of ``req_type`` SENT
+        BY ``node`` are dropped when ``f(req)`` returns False. Pass
+        ``f=None`` to remove."""
+        self._install_typed_hook(
+            self._hooks_req, node, req_type, f, is_rsp=False,
+            kind="hook_rpc_req",
+        )
+
+    def hook_rpc_rsp(self, node, rsp_type: type, f: Callable) -> None:
+        """Install THE response hook for ``node`` (mod.rs:242-264): RPC
+        responses of ``rsp_type`` about to be DELIVERED TO ``node`` are
+        dropped when ``f(rsp)`` returns False. Pass ``f=None`` to
+        remove."""
+        self._install_typed_hook(
+            self._hooks_rsp, node, rsp_type, f, is_rsp=True,
+            kind="hook_rpc_rsp",
+        )
+
     def add_send_hook(self, hook: Callable[[int, SocketAddr, object], bool]) -> int:
         """Register a chaos hook consulted before every datagram send;
         return False from the hook to drop the message (the analog of the
@@ -220,18 +323,27 @@ class NetSim(Simulator):
         latency timer -> ``Socket.deliver`` (mod.rs:273-302). Loss, clog
         and missing destination all drop silently, like UDP."""
         await self.rand_delay()
+        req_hook = self._hooks_req.get(src_node)
+        if req_hook is not None and not req_hook(msg):
+            return
         for hook in list(self._send_hooks.values()):
             if not hook(src_node, dst, msg):
                 return
         res = self.network.try_send(src_node, dst, proto)
         if res is None:
             return
-        sock, _dst_node, latency = res
-        # visible source address: loopback stays loopback
-        self.time.add_timer_at(
-            self.time.now_ns() + latency,
-            lambda: sock.deliver(src_addr, dst, msg),
-        )
+        sock, dst_node, latency = res
+        # rsp hook captured at send, consulted at delivery time like the
+        # reference's timer closure (mod.rs:291-297)
+        rsp_hook = self._hooks_rsp.get(dst_node)
+
+        def deliver() -> None:
+            if rsp_hook is not None and not rsp_hook(msg):
+                return
+            # visible source address: loopback stays loopback
+            sock.deliver(src_addr, dst, msg)
+
+        self.time.add_timer_at(self.time.now_ns() + latency, deliver)
 
     # ---- reliable connection machinery (mod.rs:306-365) ----------------
     def register_pipe(self, pipe: Pipe) -> None:

@@ -73,6 +73,8 @@ class Network:
         self._ip_to_node: dict[str, int] = {}
         self._clogged_nodes: set[int] = set()
         self._clogged_links: set[tuple[int, int]] = set()  # (src, dst) one-way
+        self._clogged_in: set[int] = set()   # deliveries TO node blocked
+        self._clogged_out: set[int] = set()  # sends FROM node blocked
 
     # ---- node lifecycle -------------------------------------------------
     def insert_node(self, node_id: int, ip: Optional[str]) -> None:
@@ -108,6 +110,20 @@ class Network:
     def unclog_node(self, node_id: int) -> None:
         self._clogged_nodes.discard(node_id)
 
+    def clog_node_in(self, node_id: int) -> None:
+        """Directional clog: messages TO the node blocked (mod.rs:183)."""
+        self._clogged_in.add(node_id)
+
+    def unclog_node_in(self, node_id: int) -> None:
+        self._clogged_in.discard(node_id)
+
+    def clog_node_out(self, node_id: int) -> None:
+        """Directional clog: messages FROM the node blocked (mod.rs:188)."""
+        self._clogged_out.add(node_id)
+
+    def unclog_node_out(self, node_id: int) -> None:
+        self._clogged_out.discard(node_id)
+
     def clog_link(self, src: int, dst: int) -> None:
         """Block messages src -> dst (one direction)."""
         self._clogged_links.add((src, dst))
@@ -119,6 +135,8 @@ class Network:
         return (
             src in self._clogged_nodes
             or dst in self._clogged_nodes
+            or src in self._clogged_out
+            or dst in self._clogged_in
             or (src, dst) in self._clogged_links
         )
 

@@ -539,3 +539,184 @@ def test_pipe_registry_does_not_grow_across_connections():
         return True
 
     assert run(23, main, time_limit=240.0)
+
+
+# ---- directional clogs, aliases, live config, typed RPC hooks ----------
+# (mod.rs:131-136, 152-213, 223-264 parity)
+
+class _PingReq:
+    def __init__(self, n):
+        self.n = n
+
+
+def _kv_service(results):
+    async def server():
+        ep = await Endpoint.bind("0.0.0.0:700")
+        ep.add_rpc_handler(_PingReq, _handler(results))
+        await ms.sleep(1000)
+    return server
+
+
+def _handler(results):
+    async def handle(req):
+        results.append(req.n)
+        return req.n * 10
+    return handle
+
+
+def test_directional_node_clog():
+    """clog_node_in blocks deliveries TO the node while its own sends
+    still flow; clog_node_out is the mirror (mod.rs:183-192)."""
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        got_b, got_a = [], []
+
+        async def rx(node_list, port):
+            ep = await Endpoint.bind(f"0.0.0.0:{port}")
+            while True:
+                payload, _ = await ep.recv_from(tag=1)
+                node_list.append(payload)
+
+        b.spawn(rx(got_b, 600))
+        a.spawn(rx(got_a, 600))
+        await ms.sleep(0.1)
+
+        async def send(frm, to_ip, val):
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to(f"{to_ip}:600", 1, val)
+
+        # in-clog on b: a->b blocked, b->a flows
+        net.clog_node_in(b)
+        a.spawn(send(a, "10.0.0.2", "a2b-clogged"))
+        b.spawn(send(b, "10.0.0.1", "b2a-ok"))
+        await ms.sleep(1.0)
+        assert got_b == [] and got_a == ["b2a-ok"]
+        net.unclog_node_in(b)
+
+        # out-clog on b: b->a blocked, a->b flows
+        net.clog_node_out(b)
+        a.spawn(send(a, "10.0.0.2", "a2b-ok"))
+        b.spawn(send(b, "10.0.0.1", "b2a-clogged"))
+        await ms.sleep(1.0)
+        assert got_b == ["a2b-ok"] and got_a == ["b2a-ok"]
+        net.unclog_node_out(b)
+        return True
+
+    assert run(21, main)
+
+
+def test_connect_disconnect_aliases():
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        received = []
+
+        async def rx():
+            ep = await Endpoint.bind("0.0.0.0:610")
+            while True:
+                p, _ = await ep.recv_from(tag=2)
+                received.append(p)
+
+        b.spawn(rx())
+        await ms.sleep(0.1)
+
+        async def send(val):
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.0.0.2:610", 2, val)
+
+        net.disconnect(b)           # = clog_node
+        a.spawn(send("while-down"))
+        await ms.sleep(0.5)
+        assert received == []
+        net.connect(b)              # = unclog_node
+        net.disconnect2(a, b)       # = clog_link both ways
+        a.spawn(send("link-down"))
+        await ms.sleep(0.5)
+        assert received == []
+        net.connect2(a, b)
+        a.spawn(send("up")); await ms.sleep(0.5)
+        assert received == ["up"]
+        return True
+
+    assert run(22, main)
+
+
+def test_update_config_live():
+    """update_config changes apply to subsequent sends (mod.rs:131)."""
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        received = []
+
+        async def rx():
+            ep = await Endpoint.bind("0.0.0.0:620")
+            while True:
+                p, _ = await ep.recv_from(tag=3)
+                received.append(p)
+
+        b.spawn(rx())
+        await ms.sleep(0.1)
+
+        async def send(val):
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ep.send_to("10.0.0.2:620", 3, val)
+
+        net.update_config(lambda c: setattr(c, "packet_loss_rate", 1.0))
+        for i in range(10):
+            a.spawn(send(i))
+        await ms.sleep(1.0)
+        assert received == []
+        net.update_config(lambda c: setattr(c, "packet_loss_rate", 0.0))
+        a.spawn(send("after"))
+        await ms.sleep(0.5)
+        assert received == ["after"]
+        return True
+
+    assert run(23, main)
+
+
+def test_hook_rpc_req_and_rsp():
+    """Typed hooks: req hook on the SENDER drops matching requests; rsp
+    hook on the CALLER drops the typed response after the handler ran
+    (mod.rs:223-264)."""
+    async def main():
+        h = ms.Handle.current()
+        net = h.simulator(NetSim)
+        a, b = two_nodes(h)
+        handled = []
+        b.spawn(_kv_service(handled)())
+        await ms.sleep(0.1)
+
+        async def call(n, timeout=2.0):
+            ep = await Endpoint.bind("0.0.0.0:0")
+            try:
+                return await ep.call(
+                    "10.0.0.2:700", _PingReq(n), timeout=timeout
+                )
+            except ms.Elapsed:
+                return "elapsed"
+
+        # baseline
+        r = await a.spawn(call(1))
+        assert r == 10 and handled == [1]
+
+        # req hook on sender a: drop odd requests
+        net.hook_rpc_req(a, _PingReq, lambda req: req.n % 2 == 0)
+        assert await a.spawn(call(2)) == 20
+        assert await a.spawn(call(3, timeout=0.5)) == "elapsed"
+        assert handled == [1, 2]          # 3 never reached the server
+        net.hook_rpc_req(a, _PingReq, None)
+
+        # rsp hook on caller a: handler runs, response dropped
+        net.hook_rpc_rsp(a, int, lambda rsp: False)
+        assert await a.spawn(call(4, timeout=0.5)) == "elapsed"
+        assert handled == [1, 2, 4]       # server DID handle it
+        net.hook_rpc_rsp(a, int, None)
+        assert await a.spawn(call(5)) == 50
+        return True
+
+    assert run(24, main)

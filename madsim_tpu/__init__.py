@@ -63,10 +63,12 @@ from .runtime import (  # noqa: F401
     sleep_until,
     span,
     spawn,
+    spawn_blocking,
     spawn_local,
     test,
     thread_rng,
     timeout,
+    yield_now,
 )
 
 # Importing the device-simulator packages registers them as default

@@ -111,6 +111,7 @@ class Endpoint:
         self._mailbox = _Mailbox()
         self._accept_backlog: deque[_Conn] = deque()
         self._accept_waiters: deque[SimFuture] = deque()
+        self._peer: Optional[SocketAddr] = None
 
     # ---- construction ---------------------------------------------------
     @classmethod
@@ -128,9 +129,43 @@ class Endpoint:
         ep._addr = bound
         return ep
 
+    @classmethod
+    async def connect(cls, dst: AddrLike) -> "Endpoint":
+        """Bind an ephemeral endpoint whose default peer is ``dst``
+        (endpoint.rs:39-45); ``send``/``recv`` then omit the address."""
+        ep = await cls.bind("0.0.0.0:0")
+        ep._peer = parse_addr(dst)
+        return ep
+
     @property
     def local_addr(self) -> SocketAddr:
         return self._addr
+
+    @property
+    def peer_addr(self) -> SocketAddr:
+        """The connected peer (endpoint.rs:52-58); raises if the
+        endpoint was bound rather than connected."""
+        if self._peer is None:
+            raise OSError("endpoint is not connected")
+        return self._peer
+
+    async def send(self, tag: int, payload: Any) -> None:
+        """Send to the connected peer (endpoint.rs:96-101)."""
+        await self.send_to(self.peer_addr, tag, payload)
+
+    async def recv(self, tag: int) -> Any:
+        """Receive a matching datagram from the connected peer
+        (endpoint.rs:103-113): errors on an unconnected endpoint, and
+        like the reference, a matching datagram from any OTHER source is
+        an error — misdelivery surfaces instead of masquerading as the
+        peer."""
+        peer = self.peer_addr
+        payload, src = await self.recv_from(tag)
+        if src != peer:
+            raise OSError(
+                f"received tag {tag} from {src}, not the connected peer {peer}"
+            )
+        return payload
 
     def close(self) -> None:
         """Unbind from the network, releasing the socket-table entry
@@ -156,9 +191,19 @@ class Endpoint:
         return (node_ip, port)
 
     # ---- tagged datagrams (endpoint.rs:68-147) --------------------------
-    async def send_to(self, dst: AddrLike, tag: int, payload: Any) -> None:
+    async def send_to(
+        self, dst: AddrLike, tag: int, payload: Any, *, _reserved: bool = False
+    ) -> None:
         """Send one tagged datagram; silently dropped on loss/partition
-        like the reference's UDP-style sends."""
+        like the reference's UDP-style sends.
+
+        Tags with bit 63 set are reserved for RPC response frames
+        (rpc.py draws response tags in that space; the typed RPC hooks
+        discriminate frames by it) — user sends may not use them."""
+        if not _reserved and isinstance(tag, int) and tag >> 63:
+            raise ValueError(
+                "tags >= 2**63 are reserved for RPC response frames"
+            )
         dst_a = parse_addr(dst)
         await self._net.send(
             self._node,

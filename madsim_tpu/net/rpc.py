@@ -31,7 +31,11 @@ def rpc_id(req_type: type) -> int:
     if explicit is not None:
         return int(explicit)
     name = f"{req_type.__module__}.{req_type.__qualname__}"
-    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:8], "big")
+    # masked to 63 bits: the bit-63 tag space is reserved for response
+    # frames (see call_with_data / Endpoint.send_to)
+    return int.from_bytes(
+        hashlib.sha256(name.encode()).digest()[:8], "big"
+    ) & ((1 << 63) - 1)
 
 
 async def call(ep, dst: AddrLike, req: Any, timeout: Optional[float] = None) -> Any:
@@ -96,7 +100,7 @@ def add_rpc_handler_with_data(
                     resp, resp_data = await handler(req, data)
                 except Exception as exc:  # noqa: BLE001 - travels to caller
                     resp, resp_data = exc, b""
-                await ep.send_to(src, resp_tag, (resp, resp_data))
+                await ep.send_to(src, resp_tag, (resp, resp_data), _reserved=True)
 
             task_mod.spawn(handle(), name=f"rpc:{req_type.__name__}")
 

@@ -112,6 +112,10 @@ class TcpStream:
             out.extend(chunk)
         return bytes(out)
 
+    def set_nodelay(self, _nodelay: bool = True) -> None:
+        """Accepted and ignored, like the reference's simulated socket
+        (stream.rs:94-98) — the sim has no Nagle buffering to disable."""
+
     def shutdown(self) -> None:
         """Close the write half; the peer sees EOF after in-flight data.
         The read half keeps working (TCP half-close)."""

@@ -16,7 +16,9 @@ from .task import (
     JoinHandle,
     TimeLimitError,
     spawn,
+    spawn_blocking,
     spawn_local,
+    yield_now,
 )
 from .time_ import (
     Elapsed,
@@ -75,7 +77,9 @@ __all__ = [
     "sleep",
     "sleep_until",
     "spawn",
+    "spawn_blocking",
     "spawn_local",
+    "yield_now",
     "test",
     "thread_rng",
     "timeout",

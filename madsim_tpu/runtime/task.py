@@ -483,3 +483,22 @@ def spawn_local(coro: Coroutine, name: str = "") -> JoinHandle:
     """Alias of :func:`spawn` — the whole simulation is single-threaded
     (task.rs:490-497)."""
     return spawn(coro, name)
+
+
+def spawn_blocking(f: Callable[[], Any], name: str = "") -> JoinHandle:
+    """Run a sync closure in a task (task.rs:498-511). The reference
+    deprecates this in simulation — real blocking would stall virtual
+    time — so like it, the closure simply runs inline on the task."""
+
+    async def runner():
+        return f()
+
+    return spawn(runner(), name or "spawn_blocking")
+
+
+def yield_now() -> "SimFuture":
+    """Cooperative yield: reschedule after other ready tasks/timers at
+    the current instant (the tokio ``task::yield_now`` re-exported by
+    the sim, madsim-tokio/src/lib.rs:25-27). Implemented as a zero
+    sleep — a timer at *now* fires without advancing the clock."""
+    return context.current_handle().time.sleep(0.0)

@@ -720,3 +720,37 @@ def test_hook_rpc_req_and_rsp():
         return True
 
     assert run(24, main)
+
+
+def test_endpoint_connect_send_recv():
+    """Endpoint.connect pins a default peer; send/recv omit the address
+    (endpoint.rs:39-45, 96-113)."""
+    async def main():
+        h = ms.Handle.current()
+        a, b = two_nodes(h)
+        done = ms.SimFuture()
+
+        async def server():
+            ep = await Endpoint.bind("0.0.0.0:650")
+            payload, src = await ep.recv_from(tag=9)
+            await ep.send_to(src, 9, payload * 2)
+
+        async def client():
+            ep = await Endpoint.connect("10.0.0.2:650")
+            assert ep.peer_addr == ("10.0.0.2", 650)
+            await ep.send(9, 21)
+            done.set_result(await ep.recv(9))
+
+        b.spawn(server())
+        await ms.sleep(0.1)
+        a.spawn(client())
+        assert await done == 42
+        # a bound (unconnected) endpoint has no peer
+        ep = await Endpoint.bind("0.0.0.0:0")
+        try:
+            ep.peer_addr
+        except OSError:
+            return True
+        raise AssertionError("peer_addr on unconnected endpoint must raise")
+
+    assert run(25, main)

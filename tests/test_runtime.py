@@ -468,3 +468,26 @@ def test_check_determinism_with_unhashable_draws():
 
     # must not crash on hash([1]) while logging draws
     assert ms.Runtime.check_determinism(seed=9, workload=wl) in ([1], [2], [3])
+
+
+def test_yield_now_and_spawn_blocking():
+    """yield_now reschedules without advancing the clock past the tick
+    (tokio task::yield_now re-export); spawn_blocking runs a sync
+    closure in a task (task.rs:498-511)."""
+    async def main():
+        t0 = ms.now_ns()
+        order = []
+
+        async def other():
+            order.append("other")
+
+        ms.spawn(other())
+        await ms.yield_now()
+        order.append("self")
+        assert order == ["other", "self"]
+        assert ms.now_ns() - t0 < 1_000_000  # poll costs only, no sleep
+        h = ms.spawn_blocking(lambda: 6 * 7)
+        assert await h == 42
+        return True
+
+    assert ms.Runtime(seed=4).block_on(main())

@@ -16,13 +16,14 @@ def test_top_level_surface():
         "Instant", "SystemTime", "thread_rng", "random", "select",
         "join_all", "Endpoint", "TcpListener", "TcpStream", "UdpSocket",
         "NetSim", "FsSim", "fs", "net", "sync",
-        "available_parallelism",
+        "available_parallelism", "spawn_blocking", "yield_now",
     ]:
         assert hasattr(ms, name), f"MIGRATING.md promises ms.{name}"
 
 
 def test_handle_and_builder_surface():
-    for name in ["kill", "restart", "pause", "resume", "create_node", "current"]:
+    for name in ["kill", "restart", "pause", "resume", "create_node",
+                 "current", "get_node"]:
         assert hasattr(ms.Handle, name)
     for name in ["name", "ip", "init", "restart_on_panic", "build"]:
         assert hasattr(ms.NodeBuilder, name)
@@ -31,8 +32,15 @@ def test_handle_and_builder_surface():
 def test_net_surface():
     from madsim_tpu.net import addr, aio_streams, rpc, service  # noqa: F401
 
-    for name in ["bind", "connect1", "accept1", "send_to", "recv_from", "call"]:
+    for name in ["bind", "connect", "connect1", "accept1", "send_to",
+                 "recv_from", "recv", "send", "peer_addr", "call"]:
         assert hasattr(ms.Endpoint, name)
+    for name in ["clog_node_in", "clog_node_out", "unclog_node_in",
+                 "unclog_node_out", "connect", "disconnect", "connect2",
+                 "disconnect2", "update_config", "hook_rpc_req",
+                 "hook_rpc_rsp"]:
+        assert hasattr(ms.NetSim, name)
+    assert hasattr(ms.TcpStream, "set_nodelay")
     assert hasattr(addr, "lookup_host")
     for name in [
         "SimTransport", "SimDatagramTransport", "SimServer",

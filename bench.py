@@ -178,7 +178,45 @@ def parent() -> None:
             if final_mode == "default":
                 platform = retry_platform
 
-    print(json.dumps(summary_dict(results, platform)), flush=True)
+    summary = summary_dict(results, platform)
+    if summary.get("platform") == "cpu":
+        banked = _banked_tpu_headline()
+        if banked is not None:
+            # the tunnel wedged for THIS run, but a real-silicon headline
+            # was banked earlier by tools/tpu_chain.sh — surface it,
+            # clearly labeled as a prior measurement with its artifact
+            summary["banked_tpu_headline"] = banked
+    print(json.dumps(summary), flush=True)
+
+
+def _banked_tpu_headline() -> dict | None:
+    """Newest RAFT_TPU_*.json banked by the watcher chain, if any —
+    attached to CPU-fallback summaries so a wedged tunnel at measurement
+    time does not hide the round's real-silicon number."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(here, "RAFT_TPU_*.json"))
+    if not paths:
+        return None
+    newest = max(paths, key=os.path.getmtime)
+    try:
+        with open(newest) as f:
+            row = json.loads(f.read().strip().splitlines()[-1])
+        if row.get("platform") == "cpu":
+            return None
+        return {
+            "note": "prior real-TPU measurement banked by tools/tpu_chain.sh; "
+                    "this run's tunnel was unavailable",
+            "artifact": os.path.basename(newest),
+            "value": row.get("value"),
+            "unit": row.get("unit"),
+            "n_seeds": row.get("n_seeds"),
+            "spread_pct": row.get("spread_pct"),
+            "vs_baseline": round(float(row["value"]) / TARGET, 4),
+        }
+    except (OSError, ValueError, IndexError, KeyError, TypeError):
+        return None
 
 
 def summary_dict(results: dict, platform: str) -> dict:

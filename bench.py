@@ -200,6 +200,11 @@ def _banked_tpu_headline() -> dict | None:
     if not paths:
         return None
     newest = max(paths, key=os.path.getmtime)
+    age_h = (time.time() - os.path.getmtime(newest)) / 3600.0
+    if age_h > 48.0:
+        # a rounds-old artifact describes a different engine; don't
+        # present it as this round's number
+        return None
     try:
         with open(newest) as f:
             row = json.loads(f.read().strip().splitlines()[-1])

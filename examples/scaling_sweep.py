@@ -16,9 +16,14 @@ is quotable only if ``overflow == 0`` and ``all_halted`` — check
 before quoting.
 
 Usage: python examples/scaling_sweep.py [out.json] [--quick] [cpu]
+                                        [--resume rows.jsonl]
   --quick: 2 s dispatches, 2 measures (for smoke runs)
   cpu: pin the CPU backend (jax.config — env vars can't, sitecustomize
        wins; required for fallback sweeps while the tunnel is wedged)
+  --resume: reuse same-platform rows already banked in rows.jsonl and
+       measure only the missing cells (the tunnel historically survives
+       ~5-15 min — one window cannot fit all ~27 cells, so the chain
+       appends each window's rows to one file and resumes)
 """
 
 from __future__ import annotations
@@ -45,16 +50,59 @@ from madsim_tpu.models import BENCH_SPECS
 SEED_COUNTS = [1024, 4096, 16384, 65536]
 
 
+def load_resume_rows(path: str, platform: str, quick: bool) -> dict:
+    """Rows already banked by a previous window, keyed (config, seeds).
+    Only rows measured on the SAME platform at the SAME quality setting
+    are reused — a CPU-fallback row must never masquerade as a TPU
+    cell, and a --quick smoke row must never satisfy a full-quality
+    sweep (rows lacking either field are from the pre-resume format
+    and are not reusable)."""
+    import os
+
+    done = {}
+    if not os.path.exists(path):
+        return done
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (
+                isinstance(rec, dict)
+                and rec.get("platform") == platform
+                and rec.get("quick") == quick
+                and "config" in rec
+                and "n_seeds" in rec
+            ):
+                done[(rec["config"], int(rec["n_seeds"]))] = rec
+    return done
+
+
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--") and a != "cpu"]
-    quick = "--quick" in sys.argv
+    argv = sys.argv[1:]
+    quick = "--quick" in argv
+    resume_path = None
+    if "--resume" in argv:
+        i = argv.index("--resume")
+        operand = argv[i + 1] if i + 1 < len(argv) else None
+        if operand is None or operand.startswith("--") or operand == "cpu":
+            raise SystemExit("--resume requires a rows.jsonl path operand")
+        resume_path = operand
+        argv = argv[:i] + argv[i + 2:]
+    args = [a for a in argv if not a.startswith("--") and a != "cpu"]
     out_path = args[0] if args else "SCALING_SWEEP.json"
     target_wall = 2.0 if quick else 5.0
     n_measure = 2 if quick else 3
 
     platform = jax.devices()[0].platform
+    done = load_resume_rows(resume_path, platform, quick) if resume_path else {}
     null = null_dispatch_stats()
-    print(f"# platform={platform} null_dispatch={json.dumps(null)}", file=sys.stderr)
+    print(f"# platform={platform} resumed_rows={len(done)} "
+          f"null_dispatch={json.dumps(null)}", file=sys.stderr)
 
     rows = []
     for name, (mk, cfg_kw, _spec_seeds, max_steps) in BENCH_SPECS.items():
@@ -62,6 +110,9 @@ def main():
         if name == "pingpong":
             counts = [1] + counts  # BASELINE config 1 is single-seed
         for s in counts:
+            if (name, s) in done:
+                rows.append(done[(name, s)])
+                continue
             t0 = time.monotonic()
             rec = measure_throughput(
                 mk(), EngineConfig(**cfg_kw), max_steps, s,
@@ -69,7 +120,10 @@ def main():
                 seed_mod=524288 if name == "raft" else 131072,
                 min_size=min(2048, max(s // 4, 1)),
             )
-            rec = {"config": name, **rec, "cell_wall_s": round(time.monotonic() - t0, 1)}
+            rec = {
+                "config": name, "platform": platform, "quick": quick, **rec,
+                "cell_wall_s": round(time.monotonic() - t0, 1),
+            }
             rows.append(rec)
             print(json.dumps(rec), flush=True)
 

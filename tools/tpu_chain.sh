@@ -148,13 +148,27 @@ if [ -f "${MARK}.sweep.done" ] && [ -f "SWEEP_TPU_${STAMP}.jsonl" ] \
   log "sweep already banked, skipping"
 else
   log "scaling sweep"
+  # rotate away a pre-resume-format partial file (its rows lack the
+  # "platform" field, are not resumable, and would duplicate cells)
+  if [ -f "SWEEP_TPU_${STAMP}.jsonl" ] \
+      && grep -q '"config"' "SWEEP_TPU_${STAMP}.jsonl" \
+      && ! grep -q '"platform"' "SWEEP_TPU_${STAMP}.jsonl"; then
+    mv "SWEEP_TPU_${STAMP}.jsonl" "SWEEP_TPU_${STAMP}.jsonl.preresume"
+    log "rotated pre-resume-format sweep rows aside"
+  fi
+  # append + --resume: ~27 cells cannot fit one 5-15 min window; rows
+  # banked by earlier windows are reused, only missing cells measure.
+  # Success requires BOTH artifacts free of CPU rows — the jsonl is the
+  # raw material consumers may quote, not just SCALING_SWEEP.json.
   if timeout 3000 python examples/scaling_sweep.py SCALING_SWEEP.json \
-      > "SWEEP_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err \
-      && ! grep -q '"platform": "cpu"' SCALING_SWEEP.json; then
+      --resume "SWEEP_TPU_${STAMP}.jsonl" \
+      >> "SWEEP_TPU_${STAMP}.jsonl" 2>> /tmp/bench_watch.err \
+      && ! grep -q '"platform": "cpu"' SCALING_SWEEP.json \
+      && ! grep -q '"platform": "cpu"' "SWEEP_TPU_${STAMP}.jsonl"; then
     touch "${MARK}.sweep.done"
     log "sweep banked"
   else
-    log "sweep FAILED or on CPU (partial rows kept)"
+    log "sweep FAILED or on CPU (partial rows kept for resume)"
     fail=1
   fi
 fi

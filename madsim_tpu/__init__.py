@@ -33,6 +33,7 @@ from .runtime import (  # noqa: F401
     DeadlockError,
     DeterminismError,
     Elapsed,
+    FallibleTask,
     Handle,
     Instant,
     Interval,

@@ -12,6 +12,7 @@ from .runtime import DEFAULT_SIMULATORS, Handle, NodeBuilder, NodeHandle, Runtim
 from .trace import SimContextFilter, SimFormatter, init_logger, span
 from .task import (
     DeadlockError,
+    FallibleTask,
     JoinError,
     JoinHandle,
     TimeLimitError,
@@ -76,6 +77,7 @@ __all__ = [
     "span",
     "sleep",
     "sleep_until",
+    "FallibleTask",
     "spawn",
     "spawn_blocking",
     "spawn_local",

@@ -40,6 +40,7 @@ __all__ = [
     "NodeInfo",
     "Task",
     "JoinHandle",
+    "FallibleTask",
     "JoinError",
     "DeadlockError",
     "TimeLimitError",
@@ -51,7 +52,21 @@ MAIN_NODE_ID = 0
 
 
 class JoinError(Exception):
-    """Awaiting a killed/aborted task (analog of task.rs:611 JoinError)."""
+    """Awaiting a killed/aborted/panicked task (task.rs:608-631).
+
+    ``is_cancelled()``/``is_panic()`` mirror the reference's accessors:
+    kill/abort produce a cancelled JoinError; a task that raised
+    produces a panic one (with the original exception as __cause__)."""
+
+    def __init__(self, msg: str, *, panic: bool = False):
+        super().__init__(msg)
+        self._panic = panic
+
+    def is_panic(self) -> bool:
+        return self._panic
+
+    def is_cancelled(self) -> bool:
+        return not self._panic
 
 
 class DeadlockError(RuntimeError):
@@ -194,6 +209,37 @@ class JoinHandle:
 
     # tokio parity alias
     cancel = abort
+
+    def cancel_on_drop(self) -> "FallibleTask":
+        """Scope-bound task (the JoinHandle::cancel_on_drop analog,
+        task.rs:581-607). Python has no deterministic drop, so the drop
+        point is an ``async with`` scope exit::
+
+            async with handle.cancel_on_drop() as h:
+                ...            # task aborted here if still running
+        """
+        return FallibleTask(self)
+
+
+class FallibleTask:
+    """Async context manager aborting its task at scope exit if still
+    running — the deterministic analog of the reference's drop-based
+    cancellation (task.rs:581-616)."""
+
+    __slots__ = ("_handle",)
+
+    def __init__(self, handle: JoinHandle):
+        self._handle = handle
+
+    async def __aenter__(self) -> JoinHandle:
+        return self._handle
+
+    async def __aexit__(self, *_exc) -> None:
+        if not self._handle.done():
+            self._handle.abort()
+
+    def __await__(self):
+        return self._handle.__await__()
 
 
 class Executor:
@@ -384,7 +430,7 @@ class Executor:
             # delay (task.rs:187-206, runtime/mod.rs:319-325).
             delay_ns = self.rng.randrange(1_000_000_000, 10_000_000_000)
             node_id = node.id
-            je = JoinError(f"task {task.name!r} panicked: {exc!r}")
+            je = JoinError(f"task {task.name!r} panicked: {exc!r}", panic=True)
             je.__cause__ = exc
             task._fut.set_exception(je)
             self.kill_node(node_id)
@@ -398,7 +444,7 @@ class Executor:
             # exception semantics — the exception is stored for the
             # awaiter (gather/await/return_exceptions all behave as in
             # real asyncio) instead of failing the whole simulation
-            je = JoinError(f"task {task.name!r} raised")
+            je = JoinError(f"task {task.name!r} raised", panic=True)
             je.__cause__ = exc
             task._fut.set_exception(je)
             return
@@ -407,7 +453,7 @@ class Executor:
         # handle expected errors, return them as values from the task.)
         # This is deliberately independent of whether anyone is awaiting the
         # JoinHandle — error routing must not depend on scheduling order.
-        je = JoinError(f"task {task.name!r} panicked")
+        je = JoinError(f"task {task.name!r} panicked", panic=True)
         je.__cause__ = exc
         task._fut.set_exception(je)
         self._pending_panic = exc

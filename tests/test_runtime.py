@@ -491,3 +491,77 @@ def test_yield_now_and_spawn_blocking():
         return True
 
     assert ms.Runtime(seed=4).block_on(main())
+
+
+def test_cancel_on_drop_scope():
+    """cancel_on_drop: the task is aborted when the scope exits with it
+    still running (the JoinHandle drop analog, task.rs:581-616)."""
+    cleaned = []
+
+    async def victim():
+        try:
+            await ms.sleep(1000.0)
+        finally:
+            cleaned.append("cleanup")
+
+    async def quick():
+        await ms.sleep(0.1)
+        return "done"
+
+    async def main():
+        async with ms.spawn(victim()).cancel_on_drop():
+            await ms.sleep(1.0)
+        await ms.sleep(0.5)
+        assert cleaned == ["cleanup"]
+        # a finished task is left alone (and awaitable through the scope)
+        ft = ms.spawn(quick()).cancel_on_drop()
+        async with ft as h:
+            assert await h == "done"
+        return True
+
+    assert ms.Runtime(seed=17).block_on(main())
+
+
+def test_join_error_is_cancelled_vs_is_panic():
+    """JoinError accessors mirror the reference (task.rs:620-631)."""
+    async def main():
+        h = ms.Handle.current()
+        node = h.create_node().build()
+        async def sleeper():
+            await ms.sleep(1000.0)
+
+        jh = node.spawn(sleeper())
+        await ms.sleep(0.1)
+        h.kill(node)
+        try:
+            await jh
+            raise AssertionError("killed task must raise JoinError")
+        except JoinError as e:
+            assert e.is_cancelled() and not e.is_panic()
+        return True
+
+    assert ms.Runtime(seed=19).block_on(main())
+
+
+def test_join_error_is_panic_on_restart_on_panic_node():
+    """A raised exception on a restart_on_panic node surfaces to the
+    JoinHandle as a panic JoinError (task.rs:620-631 accessors; the
+    cancelled branch is covered by the kill test above)."""
+    async def main():
+        h = ms.Handle.current()
+        node = h.create_node().restart_on_panic().build()
+
+        async def boom():
+            raise ValueError("kaboom")
+
+        jh = node.spawn(boom())
+        await ms.sleep(0.1)
+        try:
+            await jh
+            raise AssertionError("panicked task must raise JoinError")
+        except JoinError as e:
+            assert e.is_panic() and not e.is_cancelled()
+            assert isinstance(e.__cause__, ValueError)
+        return True
+
+    assert ms.Runtime(seed=23).block_on(main())

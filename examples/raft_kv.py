@@ -37,8 +37,9 @@ from madsim_tpu.net.service import rpc, service
 from madsim_tpu.runtime import Elapsed
 
 __all__ = [
-    "RaftPeer", "ClusterMonitor", "spawn_cluster", "client_put",
-    "client_get", "N_PEERS", "peer_addr",
+    "RaftPeer", "ClusterMonitor", "spawn_cluster", "spawn_server",
+    "client_put", "client_get", "client_add_server",
+    "client_remove_server", "N_PEERS", "peer_addr",
 ]
 
 N_PEERS = 5
@@ -99,6 +100,19 @@ class ClientGet:
         self.key = key
 
 
+class AddServer:
+    """Single-server membership change (Ongaro thesis §4.1): add sid to
+    the cluster config. One change at a time."""
+
+    def __init__(self, sid):
+        self.sid = sid
+
+
+class RemoveServer:
+    def __init__(self, sid):
+        self.sid = sid
+
+
 class Redirect:
     """Not the leader; carries a hint (the reference pattern: clients
     probe the cluster, tonic-example drives a fixed address)."""
@@ -140,7 +154,9 @@ class RaftPeer:
         self.kv = {}
         self.leader_hint = None
         self.heard_from_leader = False
-        self.apply_waiters = {}     # log idx -> SimFuture resolving to value
+        self.last_leader_ns = -(10 ** 18)   # leader-stickiness guard clock
+        self.cfg_idx = 0            # index of the latest config entry (0=none)
+        self.apply_waiters = {}     # log idx -> (term, SimFuture)
         monitor.peers[me] = self
 
     # ---- persistence (fsync-durable; kills roll back unsynced writes)
@@ -158,6 +174,7 @@ class RaftPeer:
             return
         if blob:
             self.term, self.voted_for, self.log = pickle.loads(blob)
+            self.cfg_idx = self._scan_cfg()
 
     # ---- log helpers (1-based: index 0 is the empty sentinel)
     def last_idx(self) -> int:
@@ -169,6 +186,50 @@ class RaftPeer:
     def up_to_date(self, m: RequestVote) -> bool:
         mine = (self.term_at(self.last_idx()), self.last_idx())
         return (m.last_log_term, m.last_log_idx) >= mine
+
+    # ---- membership (single-server changes, Ongaro thesis §4.1-4.2).
+    # A server uses the LATEST config entry in its log, committed or
+    # not; configs are ordinary log entries ("config", members). The
+    # latest config index is cached (cfg_idx) so the hot paths
+    # (heartbeat, campaign) stay O(1) instead of rescanning the log.
+    def _scan_cfg(self) -> int:
+        for i in range(self.last_idx(), 0, -1):
+            if self.log[i - 1][1][0] == "config":
+                return i
+        return 0
+
+    def _log_append(self, entry) -> None:
+        self.log.append(entry)
+        if entry[1][0] == "config":
+            self.cfg_idx = len(self.log)
+
+    def _log_truncate(self, from_idx: int) -> None:
+        """Delete entries from 1-based ``from_idx`` onward."""
+        del self.log[from_idx - 1:]
+        if self.cfg_idx >= from_idx:
+            self.cfg_idx = self._scan_cfg()
+
+    def config_at(self, idx: int) -> frozenset:
+        if self.cfg_idx and self.cfg_idx <= idx:
+            return frozenset(self.log[self.cfg_idx - 1][1][1])
+        # rare: asking below a config entry still in flight above idx
+        for i in range(min(idx, self.last_idx()), 0, -1):
+            cmd = self.log[i - 1][1]
+            if cmd[0] == "config":
+                return frozenset(cmd[1])
+        return frozenset(range(N_PEERS))
+
+    def current_config(self) -> frozenset:
+        if self.cfg_idx:
+            return frozenset(self.log[self.cfg_idx - 1][1][1])
+        return frozenset(range(N_PEERS))
+
+    def config_pending(self) -> bool:
+        """An uncommitted config entry forbids another change."""
+        return any(
+            self.log[i - 1][1][0] == "config"
+            for i in range(self.commit + 1, self.last_idx() + 1)
+        )
 
     def become_follower(self, term: int) -> None:
         # one vote per term: votedFor only resets when the term advances
@@ -182,6 +243,16 @@ class RaftPeer:
     # ---- RPC handlers
     @rpc
     async def request_vote(self, m: RequestVote):
+        # Leader stickiness (thesis §4.2.3): while we believe a current
+        # leader exists — we ARE it, or we heard one within the minimum
+        # election timeout — DISREGARD RequestVote entirely, no term
+        # update. This is what makes removed servers non-disruptive:
+        # their rising terms cannot depose a working leader (a
+        # partitioned stale leader still steps down via the higher term
+        # on AppendEntries replies once it reaches a member).
+        if self.role == "leader" \
+                or ms.now_ns() - self.last_leader_ns < int(ELECTION_TIMEOUT[0] * 1e9):
+            return VoteReply(self.term, False)
         if m.term > self.term:
             self.become_follower(m.term)
             await self.save()
@@ -204,6 +275,7 @@ class RaftPeer:
             self.become_follower(m.term)
             await self.save()
         self.heard_from_leader = True
+        self.last_leader_ns = ms.now_ns()
         self.leader_hint = m.leader
         if m.prev_idx > self.last_idx() or self.term_at(m.prev_idx) != m.prev_term:
             return AppendReply(self.term, False, 0)
@@ -213,11 +285,11 @@ class RaftPeer:
             idx = m.prev_idx + 1 + k
             if idx <= self.last_idx():
                 if self.term_at(idx) != ent[0]:
-                    del self.log[idx - 1:]
-                    self.log.append(ent)
+                    self._log_truncate(idx)
+                    self._log_append(ent)
                     changed = True
             else:
-                self.log.append(ent)
+                self._log_append(ent)
                 changed = True
         if changed:
             await self.save()
@@ -231,7 +303,7 @@ class RaftPeer:
     async def client_put(self, m: ClientPut):
         if self.role != "leader":
             return Redirect(self.leader_hint)
-        self.log.append((self.term, ("put", m.key, m.val)))
+        self._log_append((self.term, ("put", m.key, m.val)))
         idx = self.last_idx()
         await self.save()
         fut = ms.SimFuture(name=f"apply-{idx}")
@@ -250,19 +322,48 @@ class RaftPeer:
             return Redirect(self.leader_hint)
         return self.kv.get(m.key)
 
+    @rpc
+    async def add_server(self, m: AddServer):
+        return await self._reconfig(lambda c: c | {m.sid})
+
+    @rpc
+    async def remove_server(self, m: RemoveServer):
+        return await self._reconfig(lambda c: c - {m.sid})
+
+    async def _reconfig(self, f):
+        """Append a single-server config change; reply once committed
+        (thesis §4.1: one uncommitted change at a time)."""
+        if self.role != "leader":
+            return Redirect(self.leader_hint)
+        if self.config_pending():
+            return Redirect(self.me)    # change in flight; client retries
+        new = frozenset(f(self.current_config()))
+        if not new or new == self.current_config():
+            return "ok"                 # no-op change
+        self._log_append((self.term, ("config", tuple(sorted(new)))))
+        idx = self.last_idx()
+        await self.save()
+        fut = ms.SimFuture(name=f"cfg-{idx}")
+        self.apply_waiters[idx] = (self.term, fut)
+        return await fut
+
     # ---- apply
     def apply_committed(self) -> None:
         while self.applied < self.commit:
             self.applied += 1
-            t, (op, key, val) = self.log[self.applied - 1]
-            if op == "put":
+            t, cmd = self.log[self.applied - 1]
+            if cmd[0] == "put":
+                _, key, val = cmd
                 self.kv[key] = val
+                result = val
+            else:                       # ("config", members): no kv effect
+                result = "ok"
             entry = self.apply_waiters.pop(self.applied, None)
             if entry is not None:
                 waited_term, w = entry
                 if not w.done():
                     if waited_term == t:
-                        w.set_result(val)
+                        w.set_result(result)
                     else:
                         # the entry the client appended was replaced —
                         # its write did NOT commit; make the client retry
@@ -285,6 +386,8 @@ class RaftPeer:
         await ms.sleep(random.uniform(*ELECTION_TIMEOUT))
         if self.heard_from_leader:
             return
+        if self.me not in self.current_config():
+            return      # a non-member never campaigns (thesis §4.2.2)
         await self.campaign(ep)
 
     async def campaign(self, ep: Endpoint) -> None:
@@ -293,9 +396,10 @@ class RaftPeer:
         self.voted_for = self.me
         await self.save()
         term = self.term
+        members = self.current_config()
         req = RequestVote(term, self.me, self.last_idx(),
                           self.term_at(self.last_idx()))
-        votes = 1
+        votes = 1       # self (campaign is members-only)
 
         async def ask(i):
             try:
@@ -303,7 +407,7 @@ class RaftPeer:
             except Elapsed:
                 return None
 
-        pending = [ms.spawn(ask(i)) for i in range(N_PEERS) if i != self.me]
+        pending = [ms.spawn(ask(i)) for i in sorted(members) if i != self.me]
         for h in pending:
             r = await h
             if r is None or self.term != term or self.role != "candidate":
@@ -315,18 +419,25 @@ class RaftPeer:
             if r.granted:
                 votes += 1
         if self.role == "candidate" and self.term == term \
-                and votes * 2 > N_PEERS:
+                and votes * 2 > len(members):
             self.role = "leader"
             self.leader_hint = self.me
             self.monitor.note_leader(term, self.me)
-            self.next_idx = {i: self.last_idx() + 1 for i in range(N_PEERS)}
-            self.match_idx = {i: 0 for i in range(N_PEERS)}
+            self.next_idx = {}
+            self.match_idx = {}
+            # current-term no-op (raft §8 / thesis §3.6.1): lets the
+            # leader commit prior-term entries — without it, an
+            # uncommitted config entry inherited from a dead leader
+            # would wedge reconfiguration until an unrelated client put
+            self._log_append((self.term, ("noop",)))
+            await self.save()
 
     async def lead(self, ep: Endpoint) -> None:
         term = self.term
+        members = self.current_config()
 
         async def replicate(i):
-            prev = self.next_idx[i] - 1
+            prev = self.next_idx.setdefault(i, self.last_idx() + 1) - 1
             entries = self.log[prev:]
             req = AppendEntries(term, self.me, prev, self.term_at(prev),
                                 entries, self.commit)
@@ -341,51 +452,63 @@ class RaftPeer:
                 await self.save()
                 return
             if r.ok:
-                self.match_idx[i] = max(self.match_idx[i], r.match_idx)
+                self.match_idx[i] = max(self.match_idx.get(i, 0), r.match_idx)
                 self.next_idx[i] = self.match_idx[i] + 1
             else:
                 self.next_idx[i] = max(1, self.next_idx[i] - 1)
 
-        for i in range(N_PEERS):
+        for i in sorted(members):
             if i != self.me:
                 ms.spawn(replicate(i))
-        # leader commit rule: majority match AND entry from current term
+        # leader commit rule: majority of the CURRENT config matches AND
+        # the entry is from the current term
         for n in range(self.last_idx(), self.commit, -1):
             if self.term_at(n) != self.term:
                 break
-            count = 1 + sum(1 for i in range(N_PEERS)
-                            if i != self.me and self.match_idx[i] >= n)
-            if count * 2 > N_PEERS:
+            count = (1 if self.me in members else 0) + sum(
+                1 for i in members
+                if i != self.me and self.match_idx.get(i, 0) >= n
+            )
+            if count * 2 > len(members):
                 self.commit = n
                 self.apply_committed()
                 break
+        # a leader removed by a now-COMMITTED config steps down
+        # (thesis §4.2.2)
+        if self.me not in self.config_at(self.commit):
+            self.role = "follower"
+            return
         await ms.sleep(HEARTBEAT)
 
 
 # ---------------------------------------------------------------- harness
+def spawn_server(h, monitor: ClusterMonitor, i: int):
+    """One raft server node (also used to bring up NEW servers joining
+    via AddServer)."""
+    async def init():
+        await RaftPeer(i, monitor).run()
+
+    return (
+        h.create_node().name(f"raft-{i}").ip(peer_ip(i))
+        .init(init).build()
+    )
+
+
 def spawn_cluster(h, monitor: ClusterMonitor):
-    """Create the 5 peer nodes; returns their NodeHandles (kill/restart
-    them through the supervisor, tonic-example server_crash pattern)."""
-    nodes = []
-    for i in range(N_PEERS):
-        def make_init(i=i):
-            async def init():
-                await RaftPeer(i, monitor).run()
-            return init
-        nodes.append(
-            h.create_node().name(f"raft-{i}").ip(peer_ip(i))
-            .init(make_init()).build()
-        )
-    return nodes
+    """Create the 5 initial peer nodes; returns their NodeHandles
+    (kill/restart them through the supervisor, tonic-example
+    server_crash pattern)."""
+    return [spawn_server(h, monitor, i) for i in range(N_PEERS)]
 
 
-async def _client_call(ep: Endpoint, req, retries: int = 60):
+async def _client_call(ep: Endpoint, req, retries: int = 60, servers=None):
     """Probe for the leader with redirects + retries (clients outlive
-    elections and leader crashes)."""
+    elections, leader crashes and reconfigurations)."""
+    servers = list(servers) if servers is not None else list(range(N_PEERS))
     hint = None
     for _ in range(retries):
         order = [hint] if hint is not None else []
-        order += [i for i in range(N_PEERS) if i != hint]
+        order += [i for i in servers if i != hint]
         for i in order:
             try:
                 r = await ep.call(peer_addr(i), req, timeout=0.25)
@@ -399,12 +522,20 @@ async def _client_call(ep: Endpoint, req, retries: int = 60):
     raise TimeoutError(f"no leader answered {type(req).__name__}")
 
 
-async def client_put(ep: Endpoint, key, val):
-    return await _client_call(ep, ClientPut(key, val))
+async def client_put(ep: Endpoint, key, val, servers=None):
+    return await _client_call(ep, ClientPut(key, val), servers=servers)
 
 
-async def client_get(ep: Endpoint, key):
-    return await _client_call(ep, ClientGet(key))
+async def client_get(ep: Endpoint, key, servers=None):
+    return await _client_call(ep, ClientGet(key), servers=servers)
+
+
+async def client_add_server(ep: Endpoint, sid, servers=None):
+    return await _client_call(ep, AddServer(sid), servers=servers)
+
+
+async def client_remove_server(ep: Endpoint, sid, servers=None):
+    return await _client_call(ep, RemoveServer(sid), servers=servers)
 
 
 @ms.main

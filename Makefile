@@ -42,7 +42,8 @@ test-full: native
 determinism: native
 	MADSIM_TEST_CHECK_DETERMINISM=1 $(TESTENV) \
 	    $(PY) -m pytest tests/test_runtime.py tests/test_net.py \
-	    tests/test_aio_interpose.py tests/test_aio_streams.py -q
+	    tests/test_aio_interpose.py tests/test_aio_streams.py \
+	    tests/test_raft_example.py -q
 
 bench-smoke: native
 	BENCH_CHILD=pingpong BENCH_PLATFORM=cpu BENCH_SEEDS=4 BENCH_STEPS=100 \

@@ -54,7 +54,9 @@ def child() -> None:
 
     from madsim_tpu.engine import EngineConfig, make_init, make_run
     from madsim_tpu.engine.compact import make_run_compacted
-    from madsim_tpu.models import BENCH_SPECS, make_paxos, make_twophase
+    from madsim_tpu.models import (
+        BENCH_SPECS, make_paxos, make_snapshot, make_twophase,
+    )
 
     n_seeds = int(os.environ["CROSS_SEEDS"])
     seeds = np.arange(n_seeds, dtype=np.uint64)
@@ -81,6 +83,12 @@ def child() -> None:
     specs["paxos"] = (
         make_paxos,
         dict(pool_size=64, loss_p=0.02),
+        None,
+        400,
+    )
+    specs["snapshot"] = (
+        make_snapshot,
+        dict(pool_size=96),
         None,
         400,
     )
@@ -134,7 +142,7 @@ def main() -> None:
     # silently covered 5 of 8)
     expected = {
         "raft", "microbench", "pingpong", "broadcast", "kvchaos",
-        "raftlog", "twophase", "paxos",
+        "raftlog", "twophase", "paxos", "snapshot",
     }
     missing = expected - set(acc["configs"])
     if missing:

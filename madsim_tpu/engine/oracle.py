@@ -41,6 +41,7 @@ WORKLOAD_IDS = {
     "twophase": 5,
     "raftlog": 6,
     "paxos": 7,
+    "snapshot": 8,
 }
 
 _lib = None
@@ -161,6 +162,17 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
             ctypes.c_int32(
                 1 if model_kwargs.get("durable_acceptors", False) else 0
             ),
+        )
+    elif wl.name == "snapshot":
+        lib.oracle_set_snapshot(
+            ctypes.c_int32(model_kwargs.get("n_nodes", 5)),
+            ctypes.c_int32(model_kwargs.get("n_sends", 6)),
+            ctypes.c_int32(model_kwargs.get("balance", 1000)),
+            ctypes.c_int32(model_kwargs.get("amount_max", 100)),
+            ctypes.c_int64(model_kwargs.get("send_min_ns", 5_000_000)),
+            ctypes.c_int64(model_kwargs.get("send_max_ns", 25_000_000)),
+            ctypes.c_int64(model_kwargs.get("snap_min_ns", 20_000_000)),
+            ctypes.c_int64(model_kwargs.get("snap_max_ns", 80_000_000)),
         )
     else:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")

@@ -20,6 +20,9 @@ five cover the benchmark configs in BASELINE.md:
   8. paxos       — single-decree Paxos (dueling proposers, NACK
                    fast-forward, acceptor stable storage) under
                    proposer-crash chaos
+  9. snapshot    — Lai-Yang distributed snapshot (consistent cut under
+                   message reordering) over a money-transfer workload,
+                   with an exact conservation invariant
 """
 
 from .microbench import make_microbench  # noqa: F401
@@ -30,6 +33,7 @@ from .raftlog import make_raftlog  # noqa: F401
 from .kvchaos import make_kvchaos  # noqa: F401
 from .twophase import make_twophase  # noqa: F401
 from .paxos import make_paxos  # noqa: F401
+from .snapshot import make_snapshot  # noqa: F401
 
 # The BASELINE.md benchmark configurations, shared by bench.py and
 # examples/cross_backend_check.py so the cross-backend determinism

@@ -1,0 +1,164 @@
+"""Lai-Yang distributed snapshot over a money-transfer workload — the
+ninth oracle-verified family, covering a mechanism class none of the
+others do: **global consistent cuts under message reordering**.
+
+The classic snapshot setting (Chandy-Lamport needs FIFO channels; the
+engine's per-message random latency deliberately reorders, so this
+family implements Lai-Yang coloring, which is correct on non-FIFO
+channels): every node starts with ``balance`` units and makes
+``n_sends`` random transfers to random peers on random timers. At a
+drawn time the initiator (node 0) goes **red** and records its
+balance; every message carries its sender's color, and
+
+* a white node receiving a RED message records its balance FIRST
+  (turning red), then applies the amount — the amount is post-cut;
+* a red node receiving a WHITE message applies the amount AND records
+  it as channel state (sent pre-cut, received post-cut);
+* on turning red a node broadcasts a zero-amount red "paint" transfer
+  so color reaches nodes nobody happens to pay (loss-free family —
+  lost money would break the very invariant under test).
+
+The snapshot invariant — **conservation over the cut**:
+``sum(recorded balances) + sum(recorded channel state) == n_nodes *
+balance`` exactly, even though no two nodes record at the same virtual
+instant and transfers are in flight across the cut. Termination rides
+a witness count: every transfer (real or paint) sends a delivery
+notice to node 0, which halts the instance when all
+``n_nodes*n_sends + n_nodes*(n_nodes-1)`` messages have landed —
+reachable only after every node turned red.
+
+This is the distributed analog of the aux checkpoint story (SURVEY §5
+checkpoint/resume): a *consistent* state capture taken while the
+system keeps running, with the cut's correctness machine-checked per
+seed. Reference anchor: the fault-model machinery it runs on is the
+same NetSim semantics as every family (mod.rs:265-302 send path).
+
+State row: [color, bal, rec_bal, chan_in, sent, rcnt]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..engine import Workload, user_kind
+
+_H_INIT = 0
+_H_SEND = 1      # per-node transfer timer
+_H_TRANSFER = 2  # args = (amount, sender_color); paints are amount 0
+_H_SNAP = 3      # snapshot start (initiator only)
+_H_RECVD = 4     # delivery notice, counted by the witness (node 0)
+
+COLOR, BAL, RECBAL, CHANIN, SENT, RCNT = range(6)
+
+_P_SEND = 0
+_P_DST = 1
+_P_AMT = 2
+_P_SNAP = 3
+
+
+def make_snapshot(
+    n_nodes: int = 5,
+    n_sends: int = 6,
+    balance: int = 1000,
+    amount_max: int = 100,
+    send_min_ns: int = 5_000_000,
+    send_max_ns: int = 25_000_000,
+    snap_min_ns: int = 20_000_000,
+    snap_max_ns: int = 80_000_000,
+) -> Workload:
+    n = n_nodes
+    total_msgs = n * n_sends + n * (n - 1)
+    peers = list(range(n))
+
+    def _arm_send(ctx, eb, when):
+        d = ctx.draw.user_int(send_min_ns, send_max_ns, _P_SEND)
+        eb.after(d, user_kind(_H_SEND), ctx.node, (), when=when)
+
+    def _paints(ctx, eb, when):
+        # zero-amount red transfers to every peer: color propagation
+        for p in peers:
+            eb.send(
+                p,
+                user_kind(_H_TRANSFER),
+                (jnp.int32(0), jnp.int32(1)),
+                when=when & (jnp.int32(p) != ctx.node),
+            )
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        _arm_send(ctx, eb, True)
+        snap_d = ctx.draw.user_int(snap_min_ns, snap_max_ns, _P_SNAP)
+        eb.after(
+            snap_d, user_kind(_H_SNAP), ctx.node, (),
+            when=ctx.node == jnp.int32(0),
+        )
+        new = ctx.state.at[BAL].set(jnp.int32(balance))
+        return new, eb.build()
+
+    def on_send(ctx):
+        st = ctx.state
+        fire = st[SENT] < jnp.int32(n_sends)
+        r = ctx.draw.user_int(0, n - 1, _P_DST)          # [0, n-1)
+        dst = (ctx.node + jnp.int32(1) + jnp.asarray(r, jnp.int32)) \
+            % jnp.int32(n)                               # never self
+        amt = jnp.asarray(
+            ctx.draw.user_int(1, amount_max + 1, _P_AMT), jnp.int32
+        )
+        new = jnp.where(
+            fire, st.at[BAL].add(-amt).at[SENT].add(1), st
+        )
+        eb = ctx.emits()
+        eb.send(dst, user_kind(_H_TRANSFER), (amt, st[COLOR]), when=fire)
+        _arm_send(ctx, eb, fire & (st[SENT] + 1 < jnp.int32(n_sends)))
+        return new, eb.build()
+
+    def on_transfer(ctx):
+        st = ctx.state
+        amt, mcolor = ctx.args[0], ctx.args[1]
+        was_white = st[COLOR] == jnp.int32(0)
+        msg_red = mcolor == jnp.int32(1)
+        turn = was_white & msg_red
+        # Lai-Yang receive rules, in order: record BEFORE applying a
+        # first red message; count a white arrival at a red node as
+        # channel state; always apply the amount
+        st1 = jnp.where(
+            turn, st.at[COLOR].set(1).at[RECBAL].set(st[BAL]), st
+        )
+        chan = (~was_white) & (~msg_red)
+        st2 = jnp.where(chan, st1.at[CHANIN].add(amt), st1)
+        new = st2.at[BAL].add(amt)
+        eb = ctx.emits()
+        _paints(ctx, eb, turn)
+        eb.send(jnp.int32(0), user_kind(_H_RECVD), ())
+        return new, eb.build()
+
+    def on_snap(ctx):
+        st = ctx.state
+        turn = st[COLOR] == jnp.int32(0)
+        new = jnp.where(
+            turn, st.at[COLOR].set(1).at[RECBAL].set(st[BAL]), st
+        )
+        eb = ctx.emits()
+        _paints(ctx, eb, turn)
+        return new, eb.build()
+
+    def on_recvd(ctx):
+        st = ctx.state
+        cnt = st[RCNT] + jnp.int32(1)
+        new = st.at[RCNT].set(cnt)
+        eb = ctx.emits()
+        eb.halt(when=cnt == jnp.int32(total_msgs))
+        return new, eb.build()
+
+    return Workload(
+        name="snapshot",
+        handler_names=("init", "send", "transfer", "snap", "recvd"),
+        n_nodes=n,
+        state_width=6,
+        handlers=(on_init, on_send, on_transfer, on_snap, on_recvd),
+        # transfer: n paint slots (self slot statically present, when
+        # =False) + 1 notice
+        max_emits=max(n + 1, 2),
+        delay_bound_ns=max(send_max_ns, snap_max_ns),
+        args_words=2,
+    )

@@ -1437,6 +1437,95 @@ void paxos_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
   }
 }
 
+// snapshot (models/snapshot.py): Lai-Yang distributed snapshot over a
+// money-transfer workload — consistent cut under message reordering,
+// conservation invariant sum(rec_bal)+sum(chan_in) == n*balance.
+// Emit-row ORDER mirrors the Python EmitBuilder exactly (incl. the
+// statically-present self slot in the paint loop, when=false).
+struct SnapshotParams {
+  int32_t n_nodes, n_sends, balance, amount_max;
+  int64_t send_min_ns, send_max_ns, snap_min_ns, snap_max_ns;
+};
+SnapshotParams g_sn{5, 6, 1000, 100, 5000000, 25000000, 20000000, 80000000};
+
+void snapshot_handler(int32_t h, const Ctx& ctx, int32_t* ns, Effects* eff) {
+  const int32_t K_SEND = FIRST_USER_KIND + 1,
+                K_TRANSFER = FIRST_USER_KIND + 2,
+                K_SNAP = FIRST_USER_KIND + 3,
+                K_RECVD = FIRST_USER_KIND + 4;
+  const int32_t P_SEND = 0, P_DST = 1, P_AMT = 2, P_SNAP = 3;
+  const int32_t S_COLOR = 0, S_BAL = 1, S_RECBAL = 2, S_CHANIN = 3,
+                S_SENT = 4, S_RCNT = 5;
+  const int32_t N = g_sn.n_nodes;
+  const int32_t total_msgs = N * g_sn.n_sends + N * (N - 1);
+  const int32_t* st = ctx.state;
+  auto paints = [&](bool when) {
+    for (int32_t p = 0; p < N; p++)
+      eff->emits.push_back(
+          mk_send(p, K_TRANSFER, 0, 1, when && p != ctx.node));
+  };
+  switch (h) {
+    case 0: {  // on_init
+      int64_t d =
+          ctx.draw.user_int(g_sn.send_min_ns, g_sn.send_max_ns, P_SEND);
+      eff->emits.push_back(mk_after(d, K_SEND, ctx.node));
+      int64_t sd =
+          ctx.draw.user_int(g_sn.snap_min_ns, g_sn.snap_max_ns, P_SNAP);
+      eff->emits.push_back(mk_after(sd, K_SNAP, ctx.node, 0, ctx.node == 0));
+      ns[S_BAL] = g_sn.balance;
+      break;
+    }
+    case 1: {  // on_send (transfer timer)
+      bool fire = st[S_SENT] < g_sn.n_sends;
+      int64_t r = ctx.draw.user_int(0, N - 1, P_DST);
+      int32_t dst =
+          (ctx.node + 1 + static_cast<int32_t>(r)) % N;  // never self
+      int32_t amt = static_cast<int32_t>(
+          ctx.draw.user_int(1, g_sn.amount_max + 1, P_AMT));
+      if (fire) {
+        ns[S_BAL] = st[S_BAL] - amt;
+        ns[S_SENT] = st[S_SENT] + 1;
+      }
+      eff->emits.push_back(mk_send(dst, K_TRANSFER, amt, st[S_COLOR], fire));
+      int64_t d =
+          ctx.draw.user_int(g_sn.send_min_ns, g_sn.send_max_ns, P_SEND);
+      eff->emits.push_back(mk_after(d, K_SEND, ctx.node, 0,
+                                    fire && st[S_SENT] + 1 < g_sn.n_sends));
+      break;
+    }
+    case 2: {  // on_transfer; args = (amount, sender_color)
+      int32_t amt = ctx.args[0];
+      bool msg_red = ctx.args[1] == 1;
+      bool was_white = st[S_COLOR] == 0;
+      bool turn = was_white && msg_red;
+      if (turn) {
+        ns[S_COLOR] = 1;
+        ns[S_RECBAL] = st[S_BAL];  // record BEFORE applying
+      }
+      if (!was_white && !msg_red) ns[S_CHANIN] = st[S_CHANIN] + amt;
+      ns[S_BAL] = st[S_BAL] + amt;
+      paints(turn);
+      eff->emits.push_back(mk_send(0, K_RECVD));
+      break;
+    }
+    case 3: {  // on_snap (initiator)
+      bool turn = st[S_COLOR] == 0;
+      if (turn) {
+        ns[S_COLOR] = 1;
+        ns[S_RECBAL] = st[S_BAL];
+      }
+      paints(turn);
+      break;
+    }
+    case 4: {  // on_recvd (witness count at node 0)
+      int32_t cnt = st[S_RCNT] + 1;
+      ns[S_RCNT] = cnt;
+      eff->emits.push_back(mk_after(0, KIND_HALT, 0, 0, cnt == total_msgs));
+      break;
+    }
+  }
+}
+
 Workload make_workload(int32_t id) {
   switch (id) {
     case 0:  // pingpong
@@ -1471,6 +1560,11 @@ Workload make_workload(int32_t id) {
       if (k < 3) k = 3;
       return Workload{g_px.n_acceptors + g_px.n_proposers, 10, 8, k,
                       paxos_handler};
+    }
+    case 8: {  // snapshot: max_emits = n_nodes + 1 (paint slots + notice)
+      int32_t k = g_sn.n_nodes + 1;
+      if (k < 2) k = 2;
+      return Workload{g_sn.n_nodes, 6, 5, k, snapshot_handler};
     }
     default:
       return Workload{0, 0, 0, 0, nullptr};
@@ -1511,6 +1605,13 @@ int32_t oracle_set_raftlog(int32_t n_nodes, int32_t n_writes, int64_t tmin,
   if (n_writes > kMaxPay) return 1;  // payload arena cap
   g_rl = {n_nodes, n_writes, tmin, tmax, propose_ns, retx_ns, chaos};
   return 0;
+}
+void oracle_set_snapshot(int32_t n_nodes, int32_t n_sends, int32_t balance,
+                         int32_t amount_max, int64_t send_min_ns,
+                         int64_t send_max_ns, int64_t snap_min_ns,
+                         int64_t snap_max_ns) {
+  g_sn = {n_nodes, n_sends, balance, amount_max,
+          send_min_ns, send_max_ns, snap_min_ns, snap_max_ns};
 }
 void oracle_set_paxos(int32_t n_acceptors, int32_t n_proposers,
                       int64_t start_min_ns, int64_t start_max_ns,

@@ -215,3 +215,21 @@ def test_paxos_durable_acceptors_bit_identical():
     wl = make_paxos(durable_acceptors=True)
     cfg = EngineConfig(pool_size=64, loss_p=0.02)
     compare(wl, cfg, list(range(10)), 400, durable_acceptors=True)
+
+
+@pytest.mark.parametrize("layout", LAYOUTS)
+def test_snapshot_traces_bit_identical(layout):
+    from madsim_tpu.models import make_snapshot
+
+    wl = make_snapshot()
+    cfg = EngineConfig(pool_size=96)
+    compare(wl, cfg, list(range(12)), 400, layout=layout)
+
+
+def test_snapshot_small_cluster_bit_identical():
+    from madsim_tpu.models import make_snapshot
+
+    kw = dict(n_nodes=3, n_sends=4, balance=500, amount_max=50)
+    wl = make_snapshot(**kw)
+    cfg = EngineConfig(pool_size=64)
+    compare(wl, cfg, list(range(8)), 300, **kw)

@@ -31,6 +31,7 @@ from madsim_tpu.models import (  # noqa: E402
     make_kvchaos,
     make_microbench,
     make_paxos,
+    make_snapshot,
     make_pingpong,
     make_raft,
     make_raftlog,
@@ -60,6 +61,7 @@ CONFIGS = [
      dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
      3000, {}),
     ("paxos", make_paxos, dict(pool_size=64, loss_p=0.02), 400, {}),
+    ("snapshot", make_snapshot, dict(pool_size=96), 400, {}),
     ("paxos-durable", lambda: make_paxos(durable_acceptors=True),
      dict(pool_size=64, loss_p=0.02), 400,
      dict(durable_acceptors=True)),

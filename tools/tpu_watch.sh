@@ -15,8 +15,10 @@ PROBE_TIMEOUT="${3:-60}"
 cd "$(dirname "$0")/.."
 while true; do
   echo "$(date -u +%H:%M:%S) probing tpu..." >&2
-  if BENCH_CHILD=probe BENCH_PLATFORM=default timeout "$PROBE_TIMEOUT" \
-     python bench.py 2>/dev/null | grep -q '"ok": true'; then
+  PROBE_OUT=$(BENCH_CHILD=probe BENCH_PLATFORM=default timeout "$PROBE_TIMEOUT" \
+     python bench.py 2>/dev/null)
+  if echo "$PROBE_OUT" | grep -q '"ok": true' \
+      && ! echo "$PROBE_OUT" | grep -q '"platform": "cpu"'; then
     echo "$(date -u +%H:%M:%S) TPU UP — running artifact chain" >&2
     if tools/tpu_chain.sh "$STAMP"; then
       echo "$(date -u +%H:%M:%S) chain complete (all artifacts banked)" >&2

@@ -26,7 +26,12 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
-from madsim_tpu.models import make_paxos, make_raft, make_raftlog  # noqa: E402
+from madsim_tpu.models import (  # noqa: E402
+    make_paxos,
+    make_raft,
+    make_raftlog,
+    make_snapshot,
+)
 from madsim_tpu.models.paxos import A_VAL, P_DEC  # noqa: E402
 from madsim_tpu.models.raft import LEADER as R_LEADER  # noqa: E402
 from madsim_tpu.models.raft import ROLE as R_ROLE  # noqa: E402
@@ -85,6 +90,21 @@ def paxos_agreement(view) -> np.ndarray:
     return some & agree & valid & witness
 
 
+def snapshot_conservation(view) -> np.ndarray:
+    """Exact consistent-cut conservation (the suite's snapshot
+    assertion, vectorized): recorded balances + recorded channel state
+    == minted total, all nodes red, live balances re-conserve. 5 nodes
+    x 1000 units."""
+    from madsim_tpu.models.snapshot import BAL, CHANIN, COLOR, RECBAL
+
+    ns = np.asarray(view["node_state"])  # (S, 5, 6)
+    total = 5 * 1000
+    cut_ok = ns[:, :, RECBAL].sum(axis=1) + ns[:, :, CHANIN].sum(axis=1) == total
+    live_ok = ns[:, :, BAL].sum(axis=1) == total
+    all_red = (ns[:, :, COLOR] == 1).all(axis=1)
+    return cut_ok & live_ok & all_red
+
+
 SOAKS = [
     ("raft-election", make_raft,
      dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
@@ -99,6 +119,8 @@ SOAKS = [
      paxos_agreement),
     ("paxos-durable", lambda: make_paxos(durable_acceptors=True),
      dict(pool_size=64, loss_p=0.02), 2000, paxos_agreement),
+    ("snapshot", make_snapshot, dict(pool_size=96), 400,
+     snapshot_conservation),
 ]
 
 

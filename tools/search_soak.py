@@ -27,10 +27,12 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
 from madsim_tpu.models import (  # noqa: E402
+    make_kvchaos,
     make_paxos,
     make_raft,
     make_raftlog,
     make_snapshot,
+    make_twophase,
 )
 from madsim_tpu.models.paxos import A_VAL, P_DEC  # noqa: E402
 from madsim_tpu.models.raft import LEADER as R_LEADER  # noqa: E402
@@ -105,6 +107,29 @@ def snapshot_conservation(view) -> np.ndarray:
     return cut_ok & live_ok & all_red
 
 
+def kvchaos_durability(view) -> np.ndarray:
+    """Config-5 shape (the suite's TestKvchaos assertion, vectorized):
+    client saw all 10 commits and the final committed write is durable
+    on >= R-1 of the 4 RAM-only replicas at halt."""
+    ns = np.asarray(view["node_state"])  # (S, 6, U)
+    client_done = ns[:, 5, 0] == 10
+    durable = (ns[:, 1:5, 0] >= 10).sum(axis=1)
+    return client_done & (durable >= 3)
+
+
+def twophase_atomicity(view) -> np.ndarray:
+    """2PC (the suite's atomicity assertion, vectorized): all 5 txns
+    decided, the final decision reached every participant, and every
+    participant's stored final decision matches the coordinator's."""
+    ns = np.asarray(view["node_state"])  # (S, 5, U)
+    coord = ns[:, 0]
+    decided = (coord[:, 4] + coord[:, 5]) == 5
+    reached = (ns[:, 1:5, 2] == 5).all(axis=1)
+    coord_committed = (coord[:, 1] == 1).astype(np.int32)
+    agree = (ns[:, 1:5, 4] == coord_committed[:, None]).all(axis=1)
+    return decided & reached & agree
+
+
 SOAKS = [
     ("raft-election", make_raft,
      dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000),
@@ -121,6 +146,10 @@ SOAKS = [
      dict(pool_size=64, loss_p=0.02), 2000, paxos_agreement),
     ("snapshot", make_snapshot, dict(pool_size=96), 400,
      snapshot_conservation),
+    ("kvchaos", lambda: make_kvchaos(writes=10),
+     dict(pool_size=160, loss_p=0.05), 8000, kvchaos_durability),
+    ("twophase", lambda: make_twophase(txns=5),
+     dict(pool_size=48, loss_p=0.03), 1400, twophase_atomicity),
 ]
 
 

@@ -20,9 +20,11 @@
 
 PY      ?= python
 TESTENV ?= JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+SHELL   := /bin/bash
+# bash, not sh: the tier1 recipe uses `set -o pipefail`/PIPESTATUS
 
-.PHONY: check check-full native test test-full determinism bench-smoke \
-        bench-tpu-snapshot clean
+.PHONY: check check-full native test test-full tier1 determinism \
+        bench-smoke bench-tpu-snapshot clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -38,6 +40,17 @@ test: native
 
 test-full: native
 	$(TESTENV) $(PY) -m pytest tests/ -q
+
+# The driver's tier-1 gate, verbatim from ROADMAP.md — builders and CI
+# run THIS, not a hand-copied variant (no native dep: pure-python tier)
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	    -m 'not slow' --continue-on-collection-errors \
+	    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+	    | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$$' \
+	    /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 determinism: native
 	MADSIM_TEST_CHECK_DETERMINISM=1 $(TESTENV) \

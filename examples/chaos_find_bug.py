@@ -76,7 +76,7 @@ async def flaky_client(results: list):
                     results.append(i)
                     break
                 raise ConnectionResetError  # EOF mid-request
-            except (ConnectionError, RuntimeError, TimeoutError):
+            except (ConnectionError, RuntimeError, asyncio.TimeoutError):
                 if attempt == 2:
                     break  # BUG: request i silently lost
                 await asyncio.sleep(0.3)  # BUG: assumes 300 ms is enough

@@ -31,7 +31,7 @@ import pickle
 import random
 
 import madsim_tpu as ms
-from madsim_tpu import fs
+from madsim_tpu import check, fs
 from madsim_tpu.net import Endpoint
 from madsim_tpu.net.service import rpc, service
 from madsim_tpu.runtime import Elapsed
@@ -545,25 +545,48 @@ async def main():
     nodes = spawn_cluster(h, monitor)
     client = h.create_node().name("client").ip("10.0.9.9").build()
 
+    # the same operation-history checker that validates the batched
+    # engine's recorded histories (madsim_tpu.check) validates this
+    # asyncio-level app: record every client op, Wing–Gong check at end
+    rec = check.Recorder()
+    key_ids = {"a": 0, "b": 1, "c": 2}
+
+    async def put(ep, key, val):
+        tok = rec.invoke(client=0, op=check.OP_WRITE,
+                         key=key_ids[key], arg=val)
+        r = await client_put(ep, key, val)
+        rec.respond(tok, ok=True, value=val)
+        return r
+
+    async def get(ep, key):
+        tok = rec.invoke(client=0, op=check.OP_READ, key=key_ids[key])
+        v = await client_get(ep, key)
+        rec.respond(tok, ok=True, value=0 if v is None else v)
+        return v
+
     async def run():
         ep = await Endpoint.bind("0.0.0.0:0")
-        await client_put(ep, "a", 1)
-        await client_put(ep, "b", 2)
+        await put(ep, "a", 1)
+        await put(ep, "b", 2)
         print(f"t={ms.now_ns()/1e9:.3f}s  put a=1 b=2 committed")
         # crash the current leader, cluster must recover and keep data
         lead_term = max(monitor.leaders_by_term)
         (who,) = monitor.leaders_by_term[lead_term]
         h.kill(nodes[who])
         print(f"t={ms.now_ns()/1e9:.3f}s  killed leader raft-{who}")
-        await client_put(ep, "c", 3)
-        assert await client_get(ep, "a") == 1
-        assert await client_get(ep, "c") == 3
+        await put(ep, "c", 3)
+        assert await get(ep, "a") == 1
+        assert await get(ep, "c") == 3
         h.restart(nodes[who])
         print(f"t={ms.now_ns()/1e9:.3f}s  new leader serving; a=1 c=3 intact")
         for term in sorted(monitor.leaders_by_term):
             assert len(monitor.leaders_by_term[term]) <= 1, "election safety"
         print("election safety held:",
               {t: sorted(w) for t, w in monitor.leaders_by_term.items()})
+        lin = rec.check_kv()
+        assert lin.ok, f"client history not linearizable: {lin.reason}"
+        print(f"client history linearizable: {lin.n_ops} ops "
+              f"(madsim_tpu.check.Recorder)")
 
     await client.spawn(run())
 

@@ -1,0 +1,76 @@
+"""madsim_tpu.check — operation-history recording + workload checkers.
+
+Final-state invariants (engine/search.py) can only judge where a run
+*ended*; this package judges what the workload *observed along the
+way* — the FoundationDB-style workload verification that catches a
+committed write vanishing even when the final state looks plausible.
+
+Three layers, one history representation:
+
+* **Recording.** The batched engine appends fixed-size per-seed history
+  columns on device (``Workload.history = HistorySpec(...)`` +
+  ``EmitBuilder.record``, engine/core.py); asyncio-level apps use
+  :class:`Recorder`. Both produce (op, key, arg, client, ok) rows with
+  sim-timestamps, paired host-side into invoke/response operations
+  (check/history.py).
+* **Cheap batch checkers** (check/vectorized.py): monotonic reads,
+  read-your-writes, stale/lost-write and election-safety detectors as
+  numpy passes over the whole seed batch — the
+  ``search_seeds(history_invariant=...)`` fast path.
+* **Exact checker** (check/linearize.py): Wing–Gong/porcupine-style
+  linearizability for register and KV histories, per seed.
+
+This package imports nothing from the engine — it is a pure host-side
+consumer of the recorded columns, usable on engine results, compacted
+search views, and Recorder histories alike.
+"""
+
+from .history import (  # noqa: F401
+    COL_ARG,
+    COL_CLIENT,
+    COL_KEY,
+    COL_OK,
+    COL_OP,
+    OK_FAIL,
+    OK_OK,
+    OK_PENDING,
+    OP_READ,
+    OP_USER,
+    OP_WRITE,
+    BatchHistory,
+    HistoryError,
+    Op,
+)
+from .linearize import LinResult, check_kv, check_register  # noqa: F401
+from .recorder import Recorder  # noqa: F401
+from .vectorized import (  # noqa: F401
+    election_safety,
+    monotonic_reads,
+    read_your_writes,
+    stale_reads,
+)
+
+__all__ = [
+    "COL_ARG",
+    "COL_CLIENT",
+    "COL_KEY",
+    "COL_OK",
+    "COL_OP",
+    "OK_FAIL",
+    "OK_OK",
+    "OK_PENDING",
+    "OP_READ",
+    "OP_USER",
+    "OP_WRITE",
+    "BatchHistory",
+    "HistoryError",
+    "LinResult",
+    "Op",
+    "Recorder",
+    "check_kv",
+    "check_register",
+    "election_safety",
+    "monotonic_reads",
+    "read_your_writes",
+    "stale_reads",
+]

@@ -1,0 +1,186 @@
+"""Whole-batch history checkers: one numpy pass over every seed at once.
+
+The linearizability checker (check/linearize.py) is exact but per-seed;
+these detectors trade precision for a cost model that matches the
+batched engine — O(S·H) array passes over the raw history columns (plus
+a loop over the distinct clients/keys present, a small constant for the
+in-repo models). Each returns an ``(S,)`` boolean array, True = clean,
+i.e. exactly the ``history_invariant`` contract of
+``engine.search_seeds``.
+
+Scope (documented assumptions, not silent ones):
+
+* **Versioned registers.** ``monotonic_reads`` / ``read_your_writes`` /
+  ``stale_reads`` assume writes to a key carry strictly increasing
+  int32 versions (kvchaos: the write seq). "Fresher" is then decidable
+  per-record without a search. Non-versioned histories belong to the
+  linearizability checker.
+* **FIFO invoke/response pairing** per (client, op, key), exact for
+  clients with one outstanding op per key (all in-repo models) — same
+  rule and same caveat as ``BatchHistory.ops``.
+* Seeds whose history buffer overflowed are *not* judged here: callers
+  (``search_seeds``) quarantine them via ``hist_drop``; these passes
+  simply see the stored prefix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .history import (
+    COL_ARG,
+    COL_CLIENT,
+    COL_KEY,
+    COL_OK,
+    COL_OP,
+    OK_OK,
+    OK_PENDING,
+    OP_READ,
+    OP_WRITE,
+    BatchHistory,
+)
+
+__all__ = [
+    "monotonic_reads",
+    "read_your_writes",
+    "stale_reads",
+    "election_safety",
+]
+
+_MIN = np.int64(-(2**62))  # "no prior write" floor sentinel
+
+
+def _cols(h: BatchHistory):
+    valid = h.valid()
+    return (
+        valid,
+        h.col(COL_OP),
+        h.col(COL_KEY),
+        h.col(COL_ARG).astype(np.int64),
+        h.col(COL_CLIENT),
+        h.col(COL_OK),
+    )
+
+
+def monotonic_reads(h: BatchHistory, read_op: int = OP_READ) -> np.ndarray:
+    """Per (client, key): successive successful read values never
+    decrease (the monotonic-reads session guarantee for versioned
+    registers). Pure response-order property — no pairing needed."""
+    valid, op, key, arg, client, ok = _cols(h)
+    m = valid & (op == read_op) & (ok == OK_OK)
+    s_dim, h_dim = m.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    # sort each seed's rows by (client, key), stable → buffer (= time)
+    # order within each group; masked rows sort to a sentinel group
+    big = np.int64(2**31)
+    c_sort = np.where(m, client.astype(np.int64), big)
+    k_sort = np.where(m, key.astype(np.int64), big)
+    order = np.lexsort((k_sort, c_sort), axis=-1)
+    cs = np.take_along_axis(c_sort, order, axis=1)
+    ks = np.take_along_axis(k_sort, order, axis=1)
+    vs = np.take_along_axis(np.where(m, arg, 0), order, axis=1)
+    ms = np.take_along_axis(m, order, axis=1)
+    same = (
+        ms[:, 1:] & ms[:, :-1]
+        & (cs[:, 1:] == cs[:, :-1]) & (ks[:, 1:] == ks[:, :-1])
+    )
+    viol = same & (vs[:, 1:] < vs[:, :-1])
+    return ~viol.any(axis=1)
+
+
+def _read_floor_violations(
+    h: BatchHistory, read_op: int, write_op: int, own_writes_only: bool
+) -> np.ndarray:
+    """Shared core of read_your_writes / stale_reads: a successful read
+    must return at least the newest version whose write had completed
+    before the read was *invoked* (writes by the same client only, or by
+    anyone). Floors are sampled at the read's invoke record and carried
+    to its response by FIFO rank matching, so a write completing while
+    the read is in flight never false-flags."""
+    valid, op, key, arg, client, ok = _cols(h)
+    s_dim, h_dim = valid.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    rows = np.arange(s_dim)[:, None]
+    w_resp = valid & (op == write_op) & (ok == OK_OK)
+    r_inv = valid & (op == read_op) & (ok == OK_PENDING)
+    r_resp = valid & (op == read_op) & (ok == OK_OK)
+    viol = np.zeros(s_dim, bool)
+    keys = np.unique(key[r_resp | r_inv | w_resp])
+    clients = np.unique(client[r_resp | r_inv])
+
+    def _excl_floor(sel_w):
+        # exclusive running max of completed write versions, i.e. the
+        # floor as of each row's dispatch
+        wval = np.where(sel_w, arg, _MIN)
+        excl = np.empty_like(wval)
+        excl[:, 0] = _MIN
+        np.maximum.accumulate(wval[:, :-1], axis=1, out=excl[:, 1:])
+        return excl
+
+    for k in keys:
+        kw = w_resp & (key == k)
+        if not own_writes_only:
+            excl = _excl_floor(kw)  # client-independent: hoist
+        for c in clients:
+            if own_writes_only:
+                excl = _excl_floor(kw & (client == c))
+            inv = r_inv & (key == k) & (client == c)
+            resp = r_resp & (key == k) & (client == c)
+            # FIFO rank matching: the r-th response pairs the r-th invoke
+            inv_rank = np.cumsum(inv, axis=1) - inv
+            resp_rank = np.cumsum(resp, axis=1) - resp
+            floor_by_rank = np.full((s_dim, h_dim + 1), _MIN)
+            idx_by_rank = np.full((s_dim, h_dim + 1), h_dim)
+            inv_slot = np.where(inv, inv_rank, h_dim)
+            floor_by_rank[rows, inv_slot] = np.where(inv, excl, _MIN)
+            idx_by_rank[rows, inv_slot] = np.where(
+                inv, np.arange(h_dim)[None, :], h_dim
+            )
+            resp_slot = np.where(resp, resp_rank, h_dim)
+            floor = floor_by_rank[rows, resp_slot]
+            # a rank-matched invoke recorded AFTER the response is not
+            # its invoke (the response is a bare/instantaneous event,
+            # history.py record convention): no floor constraint, so
+            # malformed interleavings under-flag instead of false-flag
+            floor = np.where(
+                idx_by_rank[rows, resp_slot] <= np.arange(h_dim)[None, :],
+                floor, _MIN,
+            )
+            viol |= (resp & (arg < floor)).any(axis=1)
+    return ~viol
+
+
+def read_your_writes(
+    h: BatchHistory, read_op: int = OP_READ, write_op: int = OP_WRITE
+) -> np.ndarray:
+    """A client's successful read returns no older a version than its
+    own newest write completed before the read was invoked."""
+    return _read_floor_violations(h, read_op, write_op, own_writes_only=True)
+
+
+def stale_reads(
+    h: BatchHistory, read_op: int = OP_READ, write_op: int = OP_WRITE
+) -> np.ndarray:
+    """Linearizable-read form: a successful read returns no older a
+    version than the newest write completed (by *any* client) before
+    the read was invoked. On a system that routes reads through the
+    authority for the key, a flagged seed means a committed write's
+    effect vanished — the lost-write detector."""
+    return _read_floor_violations(h, read_op, write_op, own_writes_only=False)
+
+
+def election_safety(h: BatchHistory, elect_op: int) -> np.ndarray:
+    """At most one winner per term: no two successful ``elect_op``
+    records share a key (term) with different args (winners). Pairwise
+    over the history buffer — sized for election histories (capacity
+    ~tens), not for long op streams."""
+    valid, op, key, arg, client, ok = _cols(h)
+    m = valid & (op == elect_op) & (ok == OK_OK)
+    if m.shape[1] == 0:
+        return np.ones(m.shape[0], bool)
+    pair = m[:, :, None] & m[:, None, :]
+    same_key = key[:, :, None] == key[:, None, :]
+    diff_win = arg[:, :, None] != arg[:, None, :]
+    return ~(pair & same_key & diff_win).any(axis=(1, 2))

@@ -33,6 +33,7 @@ from .core import (  # noqa: E402,F401
     Emits,
     EngineConfig,
     HandlerCtx,
+    HistorySpec,
     SimState,
     Workload,
     make_init,

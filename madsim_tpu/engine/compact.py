@@ -58,7 +58,9 @@ __all__ = ["make_run_compacted"]
 
 # SimState fields reported per original seed. 'step' is excluded from
 # equality guarantees (see module docstring) but still banked so callers
-# can inspect it.
+# can inspect it. The history columns ride along so the check package
+# works on compacted results; with Workload.history=None they are
+# zero-size arrays and cost nothing.
 RESULT_FIELDS = (
     "seed",
     "now",
@@ -69,6 +71,10 @@ RESULT_FIELDS = (
     "overflow",
     "msg_count",
     "node_state",
+    "hist_count",
+    "hist_drop",
+    "hist_word",
+    "hist_t",
 )
 
 

@@ -70,6 +70,7 @@ from .rng import (
 
 __all__ = [
     "EngineConfig",
+    "HistorySpec",
     "Workload",
     "SimState",
     "Emits",
@@ -239,9 +240,14 @@ class Emits:
     delay: jnp.ndarray  # (K,)  int64 ns (timer) / ignored for sends
     args: jnp.ndarray  # (K,4) int32
     pay: jnp.ndarray  # (K,W) int32 payload words (W = Workload.payload_words)
+    # operation-history records (R = HistorySpec.max_records, 0 = off):
+    # each row is (op, key, arg, ok); the engine stamps the client node
+    # and the dispatch time when appending to the history columns
+    rec_valid: jnp.ndarray = None  # (R,) bool
+    rec: jnp.ndarray = None  # (R,4) int32
 
     @staticmethod
-    def none(k: int, w: int = 0, a: int = 4) -> "Emits":
+    def none(k: int, w: int = 0, a: int = 4, r: int = 0) -> "Emits":
         return Emits(
             valid=jnp.zeros((k,), jnp.bool_),
             send=jnp.zeros((k,), jnp.bool_),
@@ -250,6 +256,8 @@ class Emits:
             delay=jnp.zeros((k,), jnp.int64),
             args=jnp.zeros((k, a), jnp.int32),
             pay=jnp.zeros((k, w), jnp.int32),
+            rec_valid=jnp.zeros((r,), jnp.bool_),
+            rec=jnp.zeros((r, 4), jnp.int32),
         )
 
 
@@ -260,10 +268,12 @@ class EmitBuilder:
     flag is the traced per-seed condition making an emit conditional.
     """
 
-    def __init__(self, k: int, w: int = 0, a: int = 4):
+    def __init__(self, k: int, w: int = 0, a: int = 4, r: int = 0):
         self._k = k
         self._w = w
         self._a = a
+        self._r = r
+        self._recs: list[tuple] = []
         self._rows: list[tuple] = []
 
     def _push(self, send, kind, dst, delay, args, when, pay=()):
@@ -320,10 +330,51 @@ class EmitBuilder:
     def halt(self, when=True):
         self.after(0, KIND_HALT, 0, (), when)
 
+    def record(self, op, key=0, arg=0, ok=1, when=True):
+        """Append one operation-history record (madsim_tpu.check).
+
+        ``op``/``key``/``arg`` are workload-defined int32 words; ``ok``
+        follows the check.history convention (-1 = invoke of a pending
+        operation, 1 = successful response, 0 = failed response). The
+        engine stamps the record with the handling node (the client
+        column) and the dispatch sim-time. Requires ``Workload.history``.
+        """
+        if self._r == 0:
+            raise ValueError(
+                "record() needs history slots; set Workload.history to a "
+                "HistorySpec (and size its max_records)"
+            )
+        if len(self._recs) >= self._r:
+            raise ValueError(
+                f"handler records more than max_records={self._r} history "
+                f"entries; raise HistorySpec.max_records"
+            )
+        self._recs.append((when, op, key, arg, ok))
+
+    def _build_recs(self):
+        r = self._r
+        if not self._recs:
+            return (
+                jnp.zeros((r,), jnp.bool_),
+                jnp.zeros((r, 4), jnp.int32),
+            )
+        pad = r - len(self._recs)
+        valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_x) in self._recs]
+        rows = [
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in rest])
+            for (_wh, *rest) in self._recs
+        ]
+        return (
+            jnp.stack(valid + [jnp.asarray(False)] * pad),
+            jnp.stack(rows + [jnp.zeros((4,), jnp.int32)] * pad),
+        )
+
     def build(self) -> Emits:
         k, w = self._k, self._w
+        rec_valid, rec = self._build_recs()
         if not self._rows:
-            return Emits.none(k, w, self._a)
+            em = Emits.none(k, w, self._a)
+            return dataclasses.replace(em, rec_valid=rec_valid, rec=rec)
         pad = k - len(self._rows)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_r) in self._rows]
         send = [jnp.asarray(s, jnp.bool_) for (_w, s, *_r) in self._rows]
@@ -351,7 +402,38 @@ class EmitBuilder:
             delay=jnp.stack(delay + [jnp.int64(0)] * pad),
             args=jnp.stack(args + [jnp.zeros((self._a,), jnp.int32)] * pad),
             pay=jnp.stack(pay + [jnp.zeros((w,), jnp.int32)] * pad),
+            rec_valid=rec_valid,
+            rec=rec,
         )
+
+
+@dataclasses.dataclass(frozen=True)
+class HistorySpec:
+    """Per-seed operation-history recording (madsim_tpu.check).
+
+    Histories are fixed-size on-device columns, the same discipline as
+    the trace hash: ``capacity`` slots per seed, each slot one record of
+    (op, key, arg, client, ok) int32 words plus an int64 sim-time.
+    Handlers append records through :meth:`EmitBuilder.record`; a full
+    buffer never drops silently — overflow is counted in
+    ``SimState.hist_drop`` and the checkers refuse such seeds.
+
+    Sizing: one *operation* costs two records (an invoke and a
+    response); instantaneous events (e.g. an election win) cost one.
+    ``max_records`` is the per-handler-invocation slot count (the
+    history analog of ``max_emits``).
+    """
+
+    capacity: int
+    max_records: int = 2
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(f"history capacity must be >= 1, got {self.capacity}")
+        if self.max_records < 1:
+            raise ValueError(
+                f"max_records must be >= 1, got {self.max_records}"
+            )
 
 
 @dataclasses.dataclass
@@ -368,9 +450,13 @@ class HandlerCtx:
     payload: jnp.ndarray = None  # (W,) int32 — the event's payload words
     payload_words: int = 0
     args_words: int = 4
+    max_records: int = 0  # history record slots (Workload.history)
 
     def emits(self) -> EmitBuilder:
-        return EmitBuilder(self.max_emits, self.payload_words, self.args_words)
+        return EmitBuilder(
+            self.max_emits, self.payload_words, self.args_words,
+            self.max_records,
+        )
 
 
 Handler = Callable[[HandlerCtx], tuple]
@@ -420,6 +506,11 @@ class Workload:
     # default and the previous behavior). Applies to every node — pick
     # column meanings so "disk" columns line up across roles.
     durable_cols: tuple | None = None
+    # operation-history recording (madsim_tpu.check): None = off (no
+    # history columns, zero step cost). With a HistorySpec, handlers may
+    # call EmitBuilder.record and the engine appends fixed-size history
+    # rows per seed, checked host-side by the check package.
+    history: HistorySpec | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -497,6 +588,15 @@ class SimState:
     node_state: jnp.ndarray  # (N,U) int32
     # network
     clog: jnp.ndarray  # (N,N) bool — link-clog matrix (net/mod.rs:157-216)
+    # operation history (madsim_tpu.check), H = HistorySpec.capacity
+    # (0 when Workload.history is None). Rows are append-ordered by
+    # dispatch time; hist_drop counts records lost to a full buffer —
+    # a nonzero value means the seed's history verdict is unreliable
+    # (the checkers refuse it, the pool-overflow rule applied again).
+    hist_count: jnp.ndarray  # () int32 records stored
+    hist_drop: jnp.ndarray  # () int32 records dropped at capacity
+    hist_word: jnp.ndarray  # (H,5) int32 [op, key, arg, client, ok]
+    hist_t: jnp.ndarray  # (H,) int64 record sim-time ns (absolute)
 
     @property
     def sim_seconds(self):
@@ -561,6 +661,7 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
     _check_meta_ranges(wl)
     del k
     w = wl.payload_words
+    h = wl.history.capacity if wl.history is not None else 0
     tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
     base_state = jnp.asarray(wl.initial_state())
 
@@ -597,6 +698,10 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
             epoch=jnp.zeros((n,), jnp.int32),
             node_state=base_state,
             clog=jnp.zeros((n, n), jnp.bool_),
+            hist_count=jnp.int32(0),
+            hist_drop=jnp.int32(0),
+            hist_word=jnp.zeros((h, 5), jnp.int32),
+            hist_t=jnp.zeros((h,), jnp.int64),
         )
 
     def init(seeds) -> SimState:
@@ -669,6 +774,10 @@ def make_step(
     k = wl.max_emits
     w = wl.payload_words
     aw = wl.args_words
+    # history columns: capacity H and per-invocation record slots R
+    # (both 0 when recording is off — the history block compiles away)
+    hcap = wl.history.capacity if wl.history is not None else 0
+    rr = wl.history.max_records if wl.history is not None else 0
     # numpy (not jnp) so they embed as literals: a jnp closure constant
     # would block wrapping the step in a pallas kernel (pallas requires
     # traced constants to be declared inputs)
@@ -707,12 +816,30 @@ def make_step(
             payload=pay,
             payload_words=w,
             args_words=aw,
+            max_records=rr,
         )
 
     def _user_branch(handler):
         def branch(op):
             ctx = _unpack(op)
             new_state, emits = handler(ctx)
+            rv = emits.rec_valid
+            if rv is None or (rr > 0 and rv.shape[0] == 0):
+                # hand-built Emits (not via ctx.emits()): no history
+                # records — normalize to the branch pytree shape so the
+                # switch doesn't fail on a None/empty leaf
+                emits = dataclasses.replace(
+                    emits,
+                    rec_valid=jnp.zeros((rr,), jnp.bool_),
+                    rec=jnp.zeros((rr, 4), jnp.int32),
+                )
+            elif rv.shape[0] != rr:
+                raise ValueError(
+                    f"handler returned Emits with {rv.shape[0]} history-"
+                    f"record rows but the workload's HistorySpec allows "
+                    f"{rr}; build emits via ctx.emits() (EmitBuilder) to "
+                    f"get the right row count"
+                )
             return jnp.asarray(new_state, jnp.int32), emits
 
         return branch
@@ -896,7 +1023,7 @@ def make_step(
             user_state, uem = lax.switch(user_idx, user_branches, operand)
         else:
             # chaos-only workload: no user branches to run
-            user_state, uem = state_row, Emits.none(k, w, aw)
+            user_state, uem = state_row, Emits.none(k, w, aw, rr)
         user_dispatch = dispatch & ~is_engine
 
         # ---- apply node-state update (an OOB dst matches no row in the
@@ -973,6 +1100,8 @@ def make_step(
             delay=jnp.concatenate([uem.delay, jnp.zeros((1,), jnp.int64)]),
             args=jnp.concatenate([uem.args, jnp.zeros((1, aw), jnp.int32)]),
             pay=jnp.concatenate([uem.pay, jnp.zeros((1, w), jnp.int32)]),
+            rec_valid=uem.rec_valid,  # records never ride the restart row
+            rec=uem.rec,
         )
         slot_ix = jnp.arange(k + 1, dtype=jnp.uint32)  # +1: the restart row
         # one threefry block per emit slot: lane 0 = latency, lane 1 =
@@ -1104,6 +1233,48 @@ def make_step(
             ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
             ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
 
+        # ---- operation-history append (madsim_tpu.check) ----
+        # the j-th valid record takes slot hist_count+j: same compact
+        # cumsum placement as the event pool, same dense/scatter duality
+        # (values identical either way), no RNG draws — so traces and
+        # every existing workload are byte-identical with recording off.
+        # A full buffer drops records LOUDLY: hist_drop is the visible
+        # overflow flag the checkers (and search_seeds) refuse.
+        if hcap > 0:
+            r_valid = user_dispatch & uem.rec_valid
+            rpos = st.hist_count + jnp.cumsum(r_valid.astype(jnp.int32)) - 1
+            fits = rpos < hcap
+            keep = r_valid & fits
+            # row layout [op, key, arg, client, ok]: client = the node
+            # whose handler recorded it, time = the dispatch clock
+            rec_client = jnp.broadcast_to(dst, (rr,)).astype(jnp.int32)
+            rec_row = jnp.concatenate(
+                [uem.rec[:, :3], rec_client[:, None], uem.rec[:, 3:4]],
+                axis=1,
+            )
+            rec_t = jnp.broadcast_to(now, (rr,))
+            if dense:
+                hist_ids = jnp.arange(hcap, dtype=jnp.int32)
+                hmatch = keep[None, :] & (hist_ids[:, None] == rpos[None, :])
+                hany = jnp.any(hmatch, axis=1)
+                picked = jnp.sum(
+                    jnp.where(hmatch[:, :, None], rec_row[None], 0), axis=1
+                ).astype(jnp.int32)
+                hist_word = jnp.where(hany[:, None], picked, st.hist_word)
+                picked_t = jnp.sum(jnp.where(hmatch, rec_t[None], 0), axis=1)
+                hist_t = jnp.where(hany, picked_t, st.hist_t)
+            else:
+                hslot = jnp.where(keep, rpos, jnp.int32(hcap))
+                hist_word = st.hist_word.at[hslot].set(rec_row, mode="drop")
+                hist_t = st.hist_t.at[hslot].set(rec_t, mode="drop")
+            hist_count = st.hist_count + jnp.sum(keep).astype(jnp.int32)
+            hist_drop = st.hist_drop + jnp.sum(r_valid & ~fits).astype(
+                jnp.int32
+            )
+        else:
+            hist_count, hist_drop = st.hist_count, st.hist_drop
+            hist_word, hist_t = st.hist_word, st.hist_t
+
         # ---- trace + clock ----
         trace = jnp.where(
             dispatch,
@@ -1130,6 +1301,10 @@ def make_step(
             epoch=epoch,
             node_state=node_state,
             clog=clog,
+            hist_count=hist_count,
+            hist_drop=hist_drop,
+            hist_word=hist_word,
+            hist_t=hist_t,
         )
 
     return step

@@ -20,6 +20,11 @@ The invariant is a host-side predicate over the final batched state
 (numpy views), returning a boolean array over the seed axis — True =
 invariant holds. Deterministic by construction: re-running any failing
 seed (alone or in any batch) reproduces the identical trace.
+
+Final-state predicates cannot see operations that were lost along the
+way; for workloads with ``Workload.history`` the sweep also accepts a
+``history_invariant`` over the recorded per-seed operation histories
+(madsim_tpu.check) — the FoundationDB-style workload check.
 """
 
 from __future__ import annotations
@@ -68,7 +73,8 @@ class SearchReport:
     seeds: np.ndarray  # every seed searched
     ok: np.ndarray  # (S,) bool — invariant held
     halted: np.ndarray  # (S,) bool
-    overflowed: np.ndarray  # (S,) bool — event-pool drops: verdict unreliable
+    overflowed: np.ndarray  # (S,) bool — event-pool or history-buffer
+    # drops: verdict unreliable
     traces: np.ndarray  # (S,) uint64 — per-seed trace hashes
     # max per-seed step coordinate. Under compact=True the per-row step
     # counters freeze when a row is banked out, so this equals the
@@ -79,7 +85,7 @@ class SearchReport:
     @property
     def failing_seeds(self) -> np.ndarray:
         """Violations on seeds whose simulation was trustworthy (no
-        pool overflow — see :attr:`overflowed_seeds`)."""
+        pool or history overflow — see :attr:`overflowed_seeds`)."""
         return self.seeds[~self.ok & ~self.overflowed]
 
     @property
@@ -90,9 +96,12 @@ class SearchReport:
 
     @property
     def overflowed_seeds(self) -> np.ndarray:
-        """Seeds whose event pool dropped events: their verdicts are
-        simulator artifacts, not evidence — raise ``cfg.pool_size``
-        and re-search (the same rule bench.py applies to its metric)."""
+        """Seeds whose event pool dropped events (raise
+        ``cfg.pool_size``) or whose history buffer dropped records
+        (raise ``HistorySpec.capacity`` / the model's
+        ``hist_capacity``): their verdicts are simulator artifacts, not
+        evidence — fix the capacity and re-search (the same rule
+        bench.py applies to its metric)."""
         return self.seeds[self.overflowed]
 
     def banner(self, limit: int = 10) -> str:
@@ -105,7 +114,8 @@ class SearchReport:
         if self.overflowed.any():
             lines.append(
                 f"  WARNING: {int(self.overflowed.sum())} seed(s) "
-                f"overflowed the event pool; excluded (raise pool_size)"
+                f"overflowed the event pool or history buffer; excluded "
+                f"(raise pool_size / HistorySpec capacity)"
             )
         for s in bad[:limit]:
             lines.append(
@@ -130,13 +140,14 @@ def _state_view(out) -> Mapping[str, np.ndarray]:
 def search_seeds(
     wl: Workload,
     cfg: EngineConfig,
-    invariant: Callable[[Mapping[str, np.ndarray]], np.ndarray],
+    invariant: Callable[[Mapping[str, np.ndarray]], np.ndarray] | None,
     n_seeds: int = 4096,
     max_steps: int = 1000,
     seed_base: int = 0,
     require_halt: bool = True,
     layout: str | None = None,
     compact: bool = False,
+    history_invariant: Callable | None = None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -149,11 +160,31 @@ def search_seeds(
     ``compact=True`` runs the seed-compaction path (engine/compact.py):
     typically 2-3x faster on halting workloads, per-seed values
     identical — but the invariant's view then contains only the banked
-    result fields (seed/now/step/halted/halt_time/trace/overflow/
-    msg_count/node_state), not the raw event pool or clog/alive arrays.
-    Invariants over ``node_state`` (the overwhelmingly common kind) are
-    unaffected.
+    result fields (RESULT_FIELDS: seed/now/step/halted/halt_time/trace/
+    overflow/msg_count/node_state plus the history columns), not the
+    raw event pool or clog/alive arrays. Invariants over ``node_state``
+    (the overwhelmingly common kind) are unaffected.
+
+    ``history_invariant`` makes the sweep a *workload* check instead of
+    a final-state check: it receives a ``check.history.BatchHistory``
+    over the recorded operation histories of every seed at once and
+    returns a ``(n_seeds,)`` boolean array (True = history clean).
+    Requires ``wl.history``; composes with ``invariant`` (a seed must
+    pass both), and ``invariant=None`` means history-only. Seeds that
+    overflowed the history buffer are quarantined exactly like event-
+    pool overflows: their verdicts land in ``overflowed_seeds``, never
+    in ``failing_seeds`` — the invariant sees them as *empty* histories
+    (count 0, drop 0), so strict per-seed checkers
+    (``BatchHistory.ops``) can run over every seed without crashing on
+    one whose verdict would be discarded anyway.
     """
+    if history_invariant is not None and wl.history is None:
+        raise ValueError(
+            f"history_invariant needs operation histories, but workload "
+            f"{wl.name!r} has Workload.history=None"
+        )
+    if invariant is None and history_invariant is None:
+        raise ValueError("need an invariant, a history_invariant, or both")
     seeds = np.arange(seed_base, seed_base + n_seeds, dtype=np.uint64)
     init, run, _ = _compiled_run(wl, cfg, max_steps, layout, compact)
     if compact:
@@ -162,12 +193,47 @@ def search_seeds(
     else:
         out = jax.block_until_ready(run(init(seeds)))
         view = _state_view(out)
-    ok = np.asarray(invariant(view), dtype=bool)
-    if ok.shape != (n_seeds,):
-        raise ValueError(
-            f"invariant must return a ({n_seeds},) boolean array, "
-            f"got shape {ok.shape}"
-        )
+    if invariant is not None:
+        ok = np.asarray(invariant(view), dtype=bool)
+        if ok.shape != (n_seeds,):
+            raise ValueError(
+                f"invariant must return a ({n_seeds},) boolean array, "
+                f"got shape {ok.shape}"
+            )
+    else:
+        ok = np.ones((n_seeds,), dtype=bool)
+    overflowed = np.asarray(view["overflow"]) > 0
+    if history_invariant is not None:
+        # imported here: check is a consumer of the engine, not a
+        # dependency (engine -> check at module import would be a cycle)
+        from ..check.history import BatchHistory
+
+        bh = BatchHistory.from_view(view)
+        hist_over = np.asarray(bh.drop) > 0
+        if hist_over.any():
+            # overflowed seeds reach the invariant as EMPTY histories:
+            # their verdicts are discarded by the quarantine below, and
+            # a strict per-seed checker (BatchHistory.ops) must not
+            # crash the whole sweep on a seed it will never judge. The
+            # raw truncated columns stay available on the result view.
+            bh = BatchHistory(
+                word=bh.word, t=bh.t,
+                count=np.where(hist_over, 0, np.asarray(bh.count)).astype(
+                    np.int32
+                ),
+                drop=np.zeros_like(np.asarray(bh.drop)),
+            )
+        hok = np.asarray(history_invariant(bh), dtype=bool)
+        if hok.shape != (n_seeds,):
+            raise ValueError(
+                f"history_invariant must return a ({n_seeds},) boolean "
+                f"array, got shape {hok.shape}"
+            )
+        ok = ok & hok
+    if wl.history is not None:
+        # dropped history records void the verdict (loud, like pool
+        # overflow) whether or not a history predicate ran
+        overflowed = overflowed | (np.asarray(view["hist_drop"]) > 0)
     halted = view["halted"]
     if require_halt:
         ok = ok & halted
@@ -177,7 +243,7 @@ def search_seeds(
         seeds=seeds,
         ok=ok,
         halted=halted,
-        overflowed=view["overflow"] > 0,
+        overflowed=overflowed,
         traces=view["trace"],
         steps=int(np.asarray(out.step).max()),
     )

@@ -17,11 +17,28 @@ import jax
 from ..runtime.rand import DeterminismError
 from .core import EngineConfig, Workload, make_init, make_run, time32_eligible
 
-__all__ = ["check_determinism", "check_layouts", "compare_traces"]
+__all__ = [
+    "HISTORY_FIELDS",
+    "check_determinism",
+    "check_layouts",
+    "compare_traces",
+]
 
 
-def compare_traces(a, b, what: str = "run") -> None:
-    """Raise DeterminismError naming the first seed whose traces differ."""
+# operation-history buffers (engine/core.py SimState): deliberately NOT
+# folded into the trace hash (the C++ oracle mirrors the hash and knows
+# nothing of histories), so determinism checks compare them directly
+HISTORY_FIELDS = ("hist_count", "hist_drop", "hist_word", "hist_t")
+
+
+def compare_traces(a, b, what: str = "run", history: bool = True) -> None:
+    """Raise DeterminismError naming the first seed whose traces differ.
+
+    With ``history=True`` (default) the operation-history buffers are
+    compared too, when both states carry them — history columns are
+    outside the trace hash, so a divergence there would otherwise be
+    invisible to this check.
+    """
     ta, tb = np.asarray(a.trace), np.asarray(b.trace)
     if ta.shape != tb.shape:
         raise DeterminismError(
@@ -35,6 +52,28 @@ def compare_traces(a, b, what: str = "run") -> None:
             f"(seed {int(np.asarray(a.seed)[s])}) produced trace "
             f"{int(ta[s]):#x} vs {int(tb[s]):#x}"
         )
+    if not history:
+        return
+    for field in HISTORY_FIELDS:
+        da, db = getattr(a, field, None), getattr(b, field, None)
+        if da is None or db is None:
+            continue  # compacted results without banked history columns
+        da, db = np.asarray(da), np.asarray(db)
+        if da.shape != db.shape:
+            raise DeterminismError(
+                f"{what}: history field {field!r} shapes differ "
+                f"({da.shape} vs {db.shape}) — runs used different "
+                f"HistorySpec capacities"
+            )
+        if not np.array_equal(da, db):
+            s = int(
+                np.nonzero((da != db).reshape(da.shape[0], -1).any(axis=1))[0][0]
+            )
+            raise DeterminismError(
+                f"non-determinism detected in {what}: history field "
+                f"{field!r} diverged at seed index {s} "
+                f"(seed {int(np.asarray(a.seed)[s])})"
+            )
 
 
 def check_determinism(
@@ -91,7 +130,8 @@ def check_layouts(
         # node state. ev_time is excluded: representations differ by
         # design (absolute int64 vs rebased int32 offsets)
         for field in ("now", "halted", "halt_time", "msg_count", "overflow",
-                      "node_state", "ev_valid"):
+                      "node_state", "ev_valid", "hist_count", "hist_drop",
+                      "hist_word", "hist_t"):
             da = np.asarray(getattr(base, field))
             sa = np.asarray(getattr(other, field))
             if not np.array_equal(da, sa):

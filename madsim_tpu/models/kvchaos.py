@@ -23,14 +23,15 @@ periodic JOINs and is re-synced by the retx loop before halt).
 Node layout: [primary, replicas 1..R, client R+1]
 Primary state:  [committed_seq, inflight_seq, ack_mask, fin_seen]
 Replica state:  [last_applied_seq, applies, 0, 0]
-Client state:   [commits_seen, 0, 0, 0]
+Client state:   [commits_seen, last_read_rseq, 0, 0]
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+from ..check.history import OK_OK, OK_PENDING, OP_READ, OP_WRITE
+from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
 
 _H_INIT = 0
 _H_WRITE = 1  # at primary: args = (seq,)
@@ -42,6 +43,8 @@ _H_CRETX = 6  # at client: periodic progress retry
 _H_FIN = 7  # at primary: client done
 _H_JOIN = 8  # at primary: args = (replica,) — replica (re)joined
 _H_JRETX = 9  # at replica: retry JOIN until synced
+_H_READ = 10  # at primary: args = (rseq,) — record mode only
+_H_READRESP = 11  # at client: args = (rseq, committed) — record mode only
 
 PRIMARY = 0
 
@@ -59,6 +62,9 @@ def make_kvchaos(
     client_retx_ns: int = 100_000_000,
     chaos: bool = True,
     payload: bool = False,
+    record: bool = False,
+    hist_capacity: int | None = None,
+    bug: bool = False,
 ) -> Workload:
     """``payload=True`` turns on the engine payload arena: each WRITE
     carries two random int32 value words (drawn by the client, unknowable
@@ -70,7 +76,22 @@ def make_kvchaos(
     Payload state layout (state_width 6):
       Primary: [committed, inflight, mask, fin, v0, v1]
       Replica: [applied_seq, applies, v0, v1, 0, 0]
-      Client:  [commits_seen, 0, 0, 0, 0, 0]
+      Client:  [commits_seen, last_read_rseq, 0, 0, 0, 0]
+
+    ``record=True`` turns on operation-history recording (the
+    madsim_tpu.check workload check): the client records every write as
+    an invoke/response pair (version = seq), and after each commit
+    issues a best-effort READ through the primary, recording the
+    committed version it returns. A stale-rseq gate (client slot 1)
+    keeps reordered read responses out of the history. Capacity is
+    sized at 4 records/write unless ``hist_capacity`` overrides it.
+
+    ``bug=True`` plants a lost-write fault: when a replica (re)joins,
+    the primary also forgets its commit point (committed_seq := 0).
+    The protocol recovers — later acks re-commit everything, so final
+    states (and the final-state durability invariant) look perfectly
+    healthy — but a read landing in the regression window observes a
+    committed write vanish, which only the history checkers can see.
     """
     n = 1 + n_replicas + 1
     client = n - 1
@@ -78,6 +99,11 @@ def make_kvchaos(
     majority = n_replicas // 2 + 1
     full_mask = (1 << n_replicas) - 1
     width = 6 if payload else 4
+    if bug and not record:
+        raise ValueError(
+            "bug=True plants a fault only histories can see; it requires "
+            "record=True (otherwise nothing would ever detect it)"
+        )
 
     def _client_value(ctx):
         """Two fresh random words for an outgoing WRITE (payload mode)."""
@@ -102,6 +128,8 @@ def make_kvchaos(
             PRIMARY, user_kind(_H_WRITE), (jnp.int32(1),),
             when=is_client, pay=_client_value(ctx) if payload else (),
         )
+        if record:  # write 1 is invoked here (retries are the same op)
+            eb.record(OP_WRITE, 0, 1, ok=OK_PENDING, when=is_client)
         eb.after(client_retx_ns, user_kind(_H_CRETX), client, when=is_client)
         # replicas announce themselves — at t=0 and again after restart,
         # which is how the primary learns to re-sync a reborn replica;
@@ -190,6 +218,16 @@ def make_kvchaos(
             when=fresh & ~done, pay=_client_value(ctx) if payload else (),
         )
         eb.send(PRIMARY, user_kind(_H_FIN), (), when=fresh & done)
+        if record:
+            # close the pending write op with its committed version,
+            # then probe it: a best-effort READ through the primary,
+            # rseq = seq so the client can order the responses. The
+            # record order matters — the write response must precede the
+            # read invoke so the read's version floor includes it.
+            eb.record(OP_WRITE, 0, seq, ok=OK_OK, when=fresh)
+            eb.record(OP_READ, 0, 0, ok=OK_PENDING, when=fresh)
+            eb.send(PRIMARY, user_kind(_H_READ), (seq,), when=fresh)
+            eb.record(OP_WRITE, 0, seq + 1, ok=OK_PENDING, when=fresh & ~done)
         return new, eb.build()
 
     def on_retx(ctx):
@@ -240,6 +278,31 @@ def make_kvchaos(
         eb.after(retx_ns, user_kind(_H_JRETX), ctx.node, when=behind)
         return ctx.state, eb.build()
 
+    def on_read(ctx):
+        # record mode: answer a client history probe with the current
+        # commit point. Reads route through the authority for the key,
+        # so a version below the client's floor means a committed
+        # write's effect vanished (check.vectorized.stale_reads).
+        rseq = ctx.args[0]
+        st = ctx.state
+        eb = ctx.emits()
+        eb.send(client, user_kind(_H_READRESP), (rseq, st[0]))
+        return ctx.state, eb.build()
+
+    def on_readresp(ctx):
+        rseq, committed = ctx.args[0], ctx.args[1]
+        st = ctx.state
+        # stale-rseq gate: only in-invoke-order responses enter the
+        # history — a reordered older response would close the wrong
+        # pending invoke under FIFO pairing and could false-flag. The
+        # gated-out read simply stays pending, which constrains nothing.
+        fresh_r = rseq > st[1]
+        new = jnp.where(fresh_r, st.at[1].set(rseq), st)
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_READ, 0, committed, ok=OK_OK, when=fresh_r)
+        return new, eb.build()
+
     def on_join(ctx):
         # a replica (re)joined with empty state: clear its ack bit so the
         # retx loop re-replicates the current write to it
@@ -248,6 +311,14 @@ def make_kvchaos(
         bit = jnp.int32(1) << (who - 1)
         mask = st[2] & ~bit
         new = st.at[2].set(mask)
+        if bug:
+            # planted lost-write fault: re-admitting a replica also
+            # forgets the commit point. The protocol recovers — later
+            # acks re-commit everything and every final state looks
+            # healthy — but a READ landing in the regression window
+            # observes a committed write vanish, which only the
+            # operation-history checkers can see.
+            new = new.at[0].set(0)
         eb = ctx.emits()
         # the retx timer may have died while the mask was full: re-arm
         eb.after(
@@ -255,21 +326,47 @@ def make_kvchaos(
         )
         return new, eb.build()
 
+    # capacity sizing (see HistorySpec docstring): per write exactly one
+    # invoke + one response + one read invoke + at most one read
+    # response = 4 records; nothing else records
+    hist = None
+    if record:
+        # every write contributes one write op + at most one read op,
+        # all on key 0 — a single register whose exact-checker history
+        # (check_register, reached via check_kv) is bounded at 63 ops
+        if 2 * writes > 63:
+            raise ValueError(
+                f"record=True supports at most 31 writes: {writes} "
+                f"writes record up to {2 * writes} ops on the single "
+                f"key, past the 63-op bound of the exact checker "
+                f"(check/linearize.py); lower writes or record "
+                f"without the exact sweep"
+            )
+        cap = 4 * writes if hist_capacity is None else hist_capacity
+        hist = HistorySpec(capacity=cap, max_records=3)
+
+    name = "kvchaos-payload" if payload else "kvchaos"
+    if record:
+        name += "-bug" if bug else "-record"
     return Workload(
-        name="kvchaos-payload" if payload else "kvchaos",
-        handler_names=("init", "write", "repl", "ack", "commit", "retx", "cretx", "fin", "join", "jretx"),
+        name=name,
+        handler_names=(
+            "init", "write", "repl", "ack", "commit", "retx", "cretx",
+            "fin", "join", "jretx", "read", "readresp",
+        ),
         n_nodes=n,
         state_width=width,
         handlers=(
             on_init, on_write, on_repl, on_ack, on_commit, on_retx,
-            on_cretx, on_fin, on_join, on_jretx,
+            on_cretx, on_fin, on_join, on_jretx, on_read, on_readresp,
         ),
         # on_init builds up to 5 rows (write/cretx + join/jretx + 2 chaos);
         # on_retx builds n_replicas+2
         max_emits=max(n_replicas + 2, 6),
         # largest timer: chaos restart at 'at + revive' <= 300 ms + 600 ms
         delay_bound_ns=max(retx_ns, client_retx_ns, 900_000_000),
-        # handlers read args[0:2] (seq/who)
+        # handlers read args[0:2] (seq/who, rseq/committed)
         args_words=2,
         payload_words=2 if payload else 0,
+        history=hist,
     )

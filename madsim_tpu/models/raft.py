@@ -16,13 +16,21 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..engine import Workload, user_kind
+from ..check.history import OP_USER
+from ..engine import HistorySpec, Workload, user_kind
 
 _H_INIT = 0
 _H_TIMEOUT = 1  # args = (timeout_seq,)
 _H_REQVOTE = 2  # args = (term, candidate)
 _H_GRANT = 3  # args = (term,)
 _H_HEARTBEAT = 4  # args = (term,)
+
+# history op kind (record=True): an election win, recorded as an
+# instantaneous event — key = term, arg = winner.
+# check.election_safety(h, elect_op=OP_ELECT) is the history analog of
+# the final-state single-leader invariant, but over every win along the
+# way, not just the roles at halt
+OP_ELECT = OP_USER
 
 ROLE, TERM, VOTED, VOTES, TSEQ = 0, 1, 2, 3, 4
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -34,7 +42,14 @@ def make_raft(
     n_nodes: int = 5,
     timeout_min_ns: int = 150_000_000,
     timeout_max_ns: int = 300_000_000,
+    record: bool = False,
 ) -> Workload:
+    """``record=True`` turns on operation-history recording
+    (madsim_tpu.check): every election win is recorded as an
+    instantaneous ``OP_ELECT`` event (key = term, arg = winner node),
+    so ``check.election_safety`` can assert at-most-one-winner-per-term
+    over the whole seed batch — including wins that a later term
+    overwrites in the final node state."""
     majority = n_nodes // 2 + 1
     nodes = list(range(n_nodes))
 
@@ -111,6 +126,8 @@ def make_raft(
                 (term,),
                 when=wins & (jnp.int32(p) != ctx.node),
             )
+        if record:
+            eb.record(OP_ELECT, key=term, arg=ctx.node, when=wins)
         # leader elected: scenario complete (halt_time = election latency)
         eb.halt(when=wins)
         return new, eb.build()
@@ -134,7 +151,7 @@ def make_raft(
         return new, eb.build()
 
     return Workload(
-        name="raft-election",
+        name="raft-election-record" if record else "raft-election",
         handler_names=("init", "timeout", "reqvote", "grant", "heartbeat"),
         n_nodes=n_nodes,
         state_width=6,
@@ -144,4 +161,7 @@ def make_raft(
         delay_bound_ns=timeout_max_ns,
         # handlers read args[0:2] (term/candidate/seq)
         args_words=2,
+        # the run halts at the first win, so concurrent in-flight wins
+        # bound recorded events at a handful; 8 slots is generous
+        history=HistorySpec(capacity=8, max_records=1) if record else None,
     )

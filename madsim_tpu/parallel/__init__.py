@@ -16,10 +16,30 @@ deployments the way data parallelism does in the scaling playbook.
 
 from __future__ import annotations
 
+import inspect
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# jax.shard_map graduated from jax.experimental after 0.4.x; the
+# replication-check kwarg was later renamed (check_rep -> check_vma),
+# NOT at the graduation boundary — so pick the kwarg by the resolved
+# function's own signature, not by which spelling exists.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pre-graduation JAX (e.g. 0.4.37)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _sm_params = inspect.signature(_shard_map).parameters
+except (TypeError, ValueError):  # wrapped/builtin: assume current name
+    _sm_params = {"check_vma": None}
+_SM_NOCHECK = (
+    {"check_vma": False} if "check_vma" in _sm_params
+    else {"check_rep": False}
+)
 
 __all__ = [
     "make_mesh",
@@ -127,8 +147,8 @@ def shard_run_compacted(
     # Correctness is asserted value-wise instead (sharded == unsharded,
     # tests/test_parallel.py)
     sharded = jax.jit(
-        jax.shard_map(
-            local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+        _shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=spec, **_SM_NOCHECK
         )
     )
 

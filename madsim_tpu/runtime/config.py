@@ -11,7 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ImportError:  # 3.10: the installed tomli backport is API-identical
+    import tomli as tomllib
+
 from dataclasses import dataclass, field
 
 __all__ = ["NetConfig", "TcpConfig", "Config"]

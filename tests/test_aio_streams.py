@@ -371,8 +371,10 @@ def test_clog_stalls_and_resumes_raw_stream():
             writer.write(b"two\n")
             await writer.drain()
             # the request is stalled: the ack cannot arrive while the
-            # link is clogged (clog is set for 2 full seconds)
-            with pytest.raises(TimeoutError):
+            # link is clogged (clog is set for 2 full seconds).
+            # asyncio.TimeoutError: pre-3.11, wait_for raises the asyncio
+            # exception, which is NOT the builtin TimeoutError yet
+            with pytest.raises(asyncio.TimeoutError):
                 await asyncio.wait_for(reader.readline(), timeout=2.0)
             net.unclog_link(cli.id, srv.id)
             ack = await reader.readline()

@@ -1,0 +1,598 @@
+"""madsim_tpu.check — operation-history recording + workload checkers.
+
+Three layers under test: the host-side history model and checkers over
+synthetic histories (pure numpy/python, no engine), the batched engine
+integration (kvchaos/raft record modes through ``search_seeds``), and
+the proof-of-value mutation test — the seeded lost-write bug that the
+history checker catches while the final-state invariant provably
+passes it.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from madsim_tpu.check import (
+    OK_FAIL,
+    OK_OK,
+    OK_PENDING,
+    OP_READ,
+    OP_USER,
+    OP_WRITE,
+    BatchHistory,
+    HistoryError,
+    Op,
+    Recorder,
+    check_kv,
+    check_register,
+    election_safety,
+    monotonic_reads,
+    read_your_writes,
+    stale_reads,
+)
+from madsim_tpu.engine import EngineConfig, search_seeds
+from madsim_tpu.engine.core import EmitBuilder, HistorySpec, make_init, make_run
+from madsim_tpu.engine.verify import check_determinism, compare_traces
+from madsim_tpu.models import make_kvchaos, make_raft
+from madsim_tpu.models.raft import OP_ELECT
+from madsim_tpu.runtime.rand import DeterminismError
+
+W = 5  # kvchaos writes used throughout the engine-integration tests
+
+
+# --------------------------------------------------------------- helpers
+def _hist(*seeds):
+    """Synthetic BatchHistory: each seed a list of
+    (op, key, arg, client, ok, t) records in buffer order."""
+    s = len(seeds)
+    h = max((len(rows) for rows in seeds), default=0)
+    word = np.zeros((s, h, 5), np.int32)
+    t = np.zeros((s, h), np.int64)
+    count = np.zeros((s,), np.int32)
+    for i, rows in enumerate(seeds):
+        count[i] = len(rows)
+        for j, (op, key, arg, client, ok, ts) in enumerate(rows):
+            word[i, j] = (op, key, arg, client, ok)
+            t[i, j] = ts
+    return BatchHistory(word=word, t=t, count=count,
+                        drop=np.zeros((s,), np.int32))
+
+
+def _op(op, arg_inv, arg_res, ok, t_inv, t_res, idx_inv, idx_res,
+        client=0, key=0):
+    return Op(client, op, key, arg_inv, arg_res, ok, t_inv, t_res,
+              idx_inv=idx_inv, idx_res=idx_res)
+
+
+def _durability(v):
+    """The existing final-state invariant for kvchaos (config-5 shape,
+    tools/search_soak.py): client saw all W commits and the final write
+    is durable on >= R-1 of the 4 RAM-only replicas at halt."""
+    ns = np.asarray(v["node_state"])
+    client_done = ns[:, 5, 0] == W
+    durable = (ns[:, 1:5, 0] >= W).sum(axis=1)
+    return client_done & (durable >= 3)
+
+
+def _capture(checker):
+    """Wrap a history invariant so the BatchHistory it saw is kept."""
+    box = {}
+
+    def inv(h):
+        box["h"] = h
+        return checker(h)
+
+    return inv, box
+
+
+# ------------------------------------------------- linearize: register
+class TestCheckRegister:
+    def test_sequential_history_linearizes(self):
+        ops = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 0, 1),
+            _op(OP_READ, 0, 1, OK_OK, 20, 30, 2, 3),
+            _op(OP_WRITE, 2, 2, OK_OK, 40, 50, 4, 5),
+            _op(OP_READ, 0, 2, OK_OK, 60, 70, 6, 7),
+        ]
+        r = check_register(ops)
+        assert r.ok and bool(r) and r.n_ops == 4
+
+    def test_lost_write_is_rejected(self):
+        # write(1) completed strictly before the read was invoked, yet
+        # the read observed the initial value: no linearization exists
+        ops = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 0, 1),
+            _op(OP_READ, 0, 0, OK_OK, 20, 30, 2, 3),
+        ]
+        r = check_register(ops)
+        assert not r.ok and "no linearization" in r.reason
+
+    def test_same_timestamp_tie_breaks_by_record_index(self):
+        # a write response and a read invoke recorded by the same
+        # handler share a sim-time; the record index orders them, so a
+        # read observing the pre-write value is still a violation
+        ops = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 20, 0, 1),
+            _op(OP_READ, 0, 0, OK_OK, 20, 30, 2, 3),  # t_inv == t_res(w)
+        ]
+        assert not check_register(ops).ok
+
+    def test_overlapping_reads_may_resolve_out_of_order(self):
+        # the pipeline artifact: read A is invoked before write 2 and is
+        # still in flight while write 2 completes and read B returns 2;
+        # read A then returns the OLDER value 1. The client observed
+        # 2-then-1, but read A may linearize before write 2 — legal
+        # (monotonic_reads would flag this response order; the exact
+        # checker is the authority)
+        ops = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 0, 1),
+            _op(OP_READ, 0, 1, OK_OK, 20, 70, 2, 7),
+            _op(OP_WRITE, 2, 2, OK_OK, 30, 40, 3, 4),
+            _op(OP_READ, 0, 2, OK_OK, 50, 60, 5, 6),
+        ]
+        assert check_register(ops).ok
+
+    def test_pending_write_is_optional(self):
+        # a never-responded write may or may not have taken effect:
+        # reads observing either value linearize
+        pend = _op(OP_WRITE, 1, 0, OK_PENDING, 0, None, 0, None)
+        saw_new = _op(OP_READ, 0, 1, OK_OK, 10, 20, 1, 2)
+        saw_old = _op(OP_READ, 0, 0, OK_OK, 10, 20, 1, 2)
+        assert check_register([pend, saw_new]).ok
+        assert check_register([pend, saw_old]).ok
+
+    def test_failed_write_is_optional_too(self):
+        failed = _op(OP_WRITE, 7, 0, OK_FAIL, 0, 5, 0, 1)
+        saw = _op(OP_READ, 0, 7, OK_OK, 10, 20, 2, 3)
+        assert check_register([failed, saw]).ok
+
+    def test_pending_read_constrains_nothing(self):
+        ops = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 0, 1),
+            _op(OP_READ, 0, 0, OK_PENDING, 20, None, 2, None),
+        ]
+        r = check_register(ops)
+        assert r.ok and r.n_ops == 1  # the pending read was discarded
+
+    def test_rejects_foreign_op_kinds(self):
+        with pytest.raises(ValueError, match="OP_READ/OP_WRITE"):
+            check_register([_op(OP_USER, 0, 0, OK_OK, 0, 1, 0, 1)])
+
+    def test_bitmask_bound_is_enforced(self):
+        ops = [
+            _op(OP_WRITE, i, i, OK_OK, 10 * i, 10 * i + 5, 2 * i, 2 * i + 1)
+            for i in range(64)
+        ]
+        with pytest.raises(ValueError, match="63-op"):
+            check_register(ops)
+
+
+class TestCheckKv:
+    def test_keys_check_independently(self):
+        ok_key = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 0, 1, key=1),
+            _op(OP_READ, 0, 1, OK_OK, 20, 30, 2, 3, key=1),
+        ]
+        bad_key = [
+            _op(OP_WRITE, 1, 1, OK_OK, 0, 10, 4, 5, key=2),
+            _op(OP_READ, 0, 0, OK_OK, 20, 30, 6, 7, key=2),
+        ]
+        assert check_kv(ok_key).ok
+        r = check_kv(ok_key + bad_key)
+        assert not r.ok and "key 2" in r.reason
+
+
+# ------------------------------------------------- history: pairing
+class TestBatchHistoryOps:
+    def test_fifo_pairing_and_instantaneous_events(self):
+        h = _hist([
+            (OP_WRITE, 0, 1, 5, OK_PENDING, 100),
+            (OP_WRITE, 0, 1, 5, OK_OK, 200),
+            (OP_USER, 3, 9, 2, OK_OK, 300),  # no invoke: instantaneous
+            (OP_READ, 0, 0, 5, OK_PENDING, 400),
+        ])
+        ops = h.ops(0)
+        assert len(ops) == 3
+        w, ev, r = ops
+        assert (w.ok, w.t_inv, w.t_res, w.idx_inv, w.idx_res) == \
+            (OK_OK, 100, 200, 0, 1)
+        assert (ev.t_inv, ev.t_res, ev.idx_inv, ev.idx_res) == \
+            (300, 300, 2, 2)
+        assert r.ok == OK_PENDING and r.t_res is None and r.idx_res is None
+
+    def test_fifo_closes_oldest_invoke(self):
+        h = _hist([
+            (OP_READ, 0, 0, 5, OK_PENDING, 10),
+            (OP_READ, 0, 0, 5, OK_PENDING, 20),
+            (OP_READ, 0, 7, 5, OK_OK, 30),
+        ])
+        ops = h.ops(0)
+        assert ops[0].arg_res == 7 and ops[0].ok == OK_OK
+        assert ops[1].ok == OK_PENDING
+
+    def test_strict_refuses_overflowed_seed(self):
+        h = _hist([(OP_WRITE, 0, 1, 5, OK_OK, 10)])
+        h.drop[0] = 3
+        with pytest.raises(HistoryError, match="dropped 3"):
+            h.ops(0)
+        assert len(h.ops(0, strict=False)) == 1
+
+    def test_valid_mask_and_columns(self):
+        h = _hist(
+            [(OP_WRITE, 0, 1, 5, OK_OK, 10)],
+            [(OP_WRITE, 0, 1, 5, OK_OK, 10), (OP_READ, 0, 1, 5, OK_OK, 20)],
+        )
+        assert h.n_seeds == 2 and len(h) == 2
+        assert h.valid().tolist() == [[True, False], [True, True]]
+        assert not h.overflowed().any()
+
+
+# ------------------------------------------------- vectorized checkers
+class TestVectorized:
+    def test_monotonic_reads(self):
+        clean = [
+            (OP_READ, 0, 1, 5, OK_OK, 10),
+            (OP_READ, 0, 2, 5, OK_OK, 20),
+        ]
+        regress = [
+            (OP_READ, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 1, 5, OK_OK, 20),
+        ]
+        other_key = [
+            (OP_READ, 1, 2, 5, OK_OK, 10),
+            (OP_READ, 2, 1, 5, OK_OK, 20),  # different key: no pair
+        ]
+        ok = monotonic_reads(_hist(clean, regress, other_key))
+        assert ok.tolist() == [True, False, True]
+
+    def test_stale_reads_lost_write(self):
+        # write 2 completed before the read was invoked, read saw 1
+        stale = [
+            (OP_WRITE, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 0, 5, OK_PENDING, 20),
+            (OP_READ, 0, 1, 5, OK_OK, 30),
+        ]
+        # write completed only while the read was in flight: no flag
+        racing = [
+            (OP_READ, 0, 0, 5, OK_PENDING, 5),
+            (OP_WRITE, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 1, 5, OK_OK, 30),
+        ]
+        ok = stale_reads(_hist(stale, racing))
+        assert ok.tolist() == [False, True]
+
+    def test_bare_read_response_does_not_misalign_rank_matching(self):
+        # an instantaneous (bare) read response recorded before any
+        # invoke must not consume the FIFO rank of a later paired read
+        # and inherit that invoke's write floor — linearizable history,
+        # must stay clean
+        bare = [
+            (OP_READ, 0, 0, 5, OK_OK, 0),  # bare: no invoke pending
+            (OP_WRITE, 0, 5, 5, OK_OK, 10),
+            (OP_READ, 0, 0, 5, OK_PENDING, 20),
+            (OP_READ, 0, 5, 5, OK_OK, 30),
+        ]
+        assert stale_reads(_hist(bare)).tolist() == [True]
+        assert read_your_writes(_hist(bare)).tolist() == [True]
+
+    def test_read_your_writes_scopes_to_own_client(self):
+        # client 6 reads below client 5's completed write: flagged by
+        # stale_reads (any-writer floor) but NOT read-your-writes
+        cross = [
+            (OP_WRITE, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 0, 6, OK_PENDING, 20),
+            (OP_READ, 0, 1, 6, OK_OK, 30),
+        ]
+        own = [
+            (OP_WRITE, 0, 2, 6, OK_OK, 10),
+            (OP_READ, 0, 0, 6, OK_PENDING, 20),
+            (OP_READ, 0, 1, 6, OK_OK, 30),
+        ]
+        h = _hist(cross, own)
+        assert stale_reads(h).tolist() == [False, False]
+        assert read_your_writes(h).tolist() == [True, False]
+
+    def test_election_safety(self):
+        clean = [
+            (OP_ELECT, 1, 3, 3, OK_OK, 10),
+            (OP_ELECT, 2, 4, 4, OK_OK, 20),  # new term, new winner: fine
+        ]
+        split = [
+            (OP_ELECT, 1, 3, 3, OK_OK, 10),
+            (OP_ELECT, 1, 4, 4, OK_OK, 20),  # two winners, one term
+        ]
+        ok = election_safety(_hist(clean, split), elect_op=OP_ELECT)
+        assert ok.tolist() == [True, False]
+
+    def test_empty_history_is_clean(self):
+        h = BatchHistory(
+            word=np.zeros((3, 0, 5), np.int32), t=np.zeros((3, 0), np.int64),
+            count=np.zeros((3,), np.int32), drop=np.zeros((3,), np.int32),
+        )
+        assert monotonic_reads(h).all()
+        assert stale_reads(h).all()
+        assert read_your_writes(h).all()
+        assert election_safety(h, elect_op=OP_ELECT).all()
+
+
+# ------------------------------------------------- Recorder (runtime)
+class TestRecorder:
+    def test_invoke_respond_roundtrip(self):
+        clock = iter(range(0, 1000, 10))
+        rec = Recorder(clock=lambda: next(clock))
+        t1 = rec.invoke(client=0, op=OP_WRITE, key=1, arg=42)
+        rec.respond(t1, ok=True, value=42)
+        t2 = rec.invoke(client=0, op=OP_READ, key=1)
+        rec.respond(t2, ok=True, value=42)
+        rec.event(client=9, op=OP_USER, key=3, arg=7)
+        assert len(rec) == 5
+        # the KV model rejects workload-specific events: filter them
+        with pytest.raises(ValueError, match="OP_READ/OP_WRITE"):
+            rec.check_kv()
+        ops = [o for o in rec.ops() if o.op != OP_USER]
+        assert check_kv(ops).ok
+
+    def test_out_of_order_responses_pair_by_token(self):
+        # two reads concurrently open on one (client, key), responding
+        # in the opposite order of their invokes; engine-style FIFO
+        # pairing would hand r1's late value-0 response to r2 (invoked
+        # after the write completed) and false-flag — token pairing
+        # keeps the history linearizable
+        clock = iter(range(0, 1000, 10))
+        rec = Recorder(clock=lambda: next(clock))
+        r1 = rec.invoke(client=0, op=OP_READ, key=0)
+        w = rec.invoke(client=1, op=OP_WRITE, key=0, arg=1)
+        rec.respond(w, ok=True, value=1)
+        r2 = rec.invoke(client=0, op=OP_READ, key=0)
+        rec.respond(r2, ok=True, value=1)
+        rec.respond(r1, ok=True, value=0)  # linearizes before the write
+        assert rec.check_kv().ok
+
+    def test_unknown_token_rejected(self):
+        rec = Recorder(clock=lambda: 0)
+        tok = rec.invoke(client=0, op=OP_WRITE, key=0, arg=1)
+        rec.respond(tok)
+        with pytest.raises(ValueError, match="not an open invocation"):
+            rec.respond(tok)
+
+    def test_recorder_catches_lost_write(self):
+        clock = iter(range(0, 1000, 10))
+        rec = Recorder(clock=lambda: next(clock))
+        tok = rec.invoke(client=0, op=OP_WRITE, key=0, arg=5)
+        rec.respond(tok, ok=True, value=5)
+        tok = rec.invoke(client=0, op=OP_READ, key=0)
+        rec.respond(tok, ok=True, value=0)  # the write vanished
+        r = rec.check_register()
+        assert not r.ok
+
+    def test_recorder_batch_view_matches_vectorized_contract(self):
+        rec = Recorder(clock=lambda: 7)
+        rec.event(client=1, op=OP_ELECT, key=1, arg=2)
+        rec.event(client=3, op=OP_ELECT, key=1, arg=4)
+        assert election_safety(rec.to_batch(), elect_op=OP_ELECT).tolist() \
+            == [False]
+
+
+# ------------------------------------------------- engine integration
+@pytest.fixture(scope="module")
+def kv_record_report():
+    inv, box = _capture(lambda h: stale_reads(h) & read_your_writes(h))
+    rep = search_seeds(
+        make_kvchaos(writes=W, record=True),
+        EngineConfig(pool_size=192, loss_p=0.05),
+        _durability, n_seeds=128, max_steps=1500, history_invariant=inv,
+    )
+    return rep, box["h"]
+
+
+class TestEngineRecording:
+    def test_clean_model_has_no_history_violations(self, kv_record_report):
+        rep, h = kv_record_report
+        assert rep.failing_seeds.size == 0
+        assert rep.overflowed_seeds.size == 0
+        assert rep.unhalted_seeds.size == 0
+
+    def test_history_shape_and_capacity_sizing(self, kv_record_report):
+        rep, h = kv_record_report
+        # exactly 4 records per write worst-case (see make_kvchaos):
+        # W invokes + W responses + W read invokes + <= W read responses
+        assert h.word.shape == (128, 4 * W, 5)
+        assert (h.count >= 3 * W).all() and (h.count <= 4 * W).all()
+        assert (h.drop == 0).all()
+
+    def test_whole_batch_linearizable(self, kv_record_report):
+        rep, h = kv_record_report
+        for s in range(h.n_seeds):
+            r = check_kv(h.ops(s))
+            assert r.ok, f"seed index {s}: {r.reason}"
+
+    def test_history_timestamps_are_dispatch_ordered(self, kv_record_report):
+        rep, h = kv_record_report
+        for s in range(h.n_seeds):
+            n = int(h.count[s])
+            t = h.t[s, :n]
+            assert (np.diff(t) >= 0).all()
+
+    def test_history_invariant_requires_history_spec(self):
+        with pytest.raises(ValueError, match="Workload.history=None"):
+            search_seeds(
+                make_kvchaos(writes=W), EngineConfig(pool_size=192),
+                _durability, n_seeds=8, max_steps=100,
+                history_invariant=lambda h: np.ones(8, bool),
+            )
+
+    def test_some_invariant_is_required(self):
+        with pytest.raises(ValueError, match="history_invariant"):
+            search_seeds(
+                make_kvchaos(writes=W), EngineConfig(pool_size=192),
+                None, n_seeds=8, max_steps=100,
+            )
+
+    def test_bug_flag_requires_record(self):
+        with pytest.raises(ValueError, match="requires record=True"):
+            make_kvchaos(writes=W, bug=True)
+
+    def test_record_bounds_writes_to_exact_checker_limit(self):
+        # 32 writes -> up to 64 ops on the single key, past the 63-op
+        # Wing-Gong bound: rejected at build time, not mid-sweep
+        with pytest.raises(ValueError, match="at most 31 writes"):
+            make_kvchaos(writes=32, record=True)
+        make_kvchaos(writes=31, record=True)  # at the bound: fine
+
+    def test_record_without_history_spec_is_rejected(self):
+        eb = EmitBuilder(k=2)
+        with pytest.raises(ValueError, match="HistorySpec"):
+            eb.record(OP_WRITE, 0, 1)
+
+    def test_max_records_overflow_is_rejected(self):
+        eb = EmitBuilder(k=2, r=1)
+        eb.record(OP_WRITE, 0, 1)
+        with pytest.raises(ValueError, match="max_records"):
+            eb.record(OP_WRITE, 0, 2)
+
+    def test_history_spec_validates(self):
+        with pytest.raises(ValueError, match="capacity"):
+            HistorySpec(capacity=0)
+        with pytest.raises(ValueError, match="max_records"):
+            HistorySpec(capacity=4, max_records=0)
+
+
+class TestHistoryDeterminism:
+    def test_history_columns_bit_identical_across_runs(self):
+        # the satellite determinism gate: two same-seed runs produce
+        # bit-identical history buffers, and compare_traces covers them
+        wl = make_kvchaos(writes=W, record=True)
+        cfg = EngineConfig(pool_size=192, loss_p=0.05)
+        seeds = np.arange(64, dtype=np.uint64)
+        init = make_init(wl, cfg)
+        run = jax.jit(make_run(wl, cfg, 1500))
+        a = jax.block_until_ready(run(init(seeds)))
+        b = jax.block_until_ready(run(init(seeds)))
+        compare_traces(a, b, what="kvchaos-record x2")
+        for f in ("hist_count", "hist_drop", "hist_word", "hist_t"):
+            assert np.array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f))), f
+
+    def test_compare_traces_detects_history_divergence(self):
+        wl = make_kvchaos(writes=W, record=True)
+        cfg = EngineConfig(pool_size=192, loss_p=0.05)
+        seeds = np.arange(8, dtype=np.uint64)
+        init = make_init(wl, cfg)
+        run = jax.jit(make_run(wl, cfg, 1500))
+        a = jax.block_until_ready(run(init(seeds)))
+        # corrupt one history word of seed 3: the trace hash cannot see
+        # it (histories are outside the hash), compare_traces must
+        word = np.asarray(a.hist_word).copy()
+        word[3, 0, 2] += 1
+        b = dataclasses.replace(a, hist_word=word)
+        with pytest.raises(DeterminismError, match="hist_word.*seed index 3"):
+            compare_traces(a, b, what="tampered")
+        compare_traces(a, b, what="tampered", history=False)  # opt-out
+
+    def test_check_determinism_covers_record_mode(self):
+        check_determinism(
+            make_kvchaos(writes=W, record=True),
+            EngineConfig(pool_size=192, loss_p=0.05),
+            np.arange(16, dtype=np.uint64), 1500,
+        )
+
+
+class TestHistoryOverflow:
+    def test_overflow_sets_flag_and_quarantines(self):
+        # capacity 6 < the ~4W records a full run appends: every seed
+        # overflows VISIBLY — hist_drop counts, search quarantines, and
+        # the invariant sees quarantined seeds as EMPTY histories (its
+        # verdict on them is discarded, so a strict per-seed checker
+        # must not crash the sweep)
+        inv, box = _capture(lambda h: stale_reads(h))
+        rep = search_seeds(
+            make_kvchaos(writes=W, record=True, hist_capacity=6),
+            EngineConfig(pool_size=192, loss_p=0.05),
+            _durability, n_seeds=32, max_steps=1500, history_invariant=inv,
+        )
+        h = box["h"]
+        assert (h.count == 0).all()  # sanitized: nothing to judge
+        assert (h.drop == 0).all()
+        assert h.ops(0) == []  # strict ops() is safe on the sanitized view
+        assert rep.overflowed_seeds.size == 32
+        assert rep.failing_seeds.size == 0  # quarantined, not "violations"
+        # the RAW columns keep the stored prefix and the loud drop count
+        wl = make_kvchaos(writes=W, record=True, hist_capacity=6)
+        cfg = EngineConfig(pool_size=192, loss_p=0.05)
+        run = jax.jit(make_run(wl, cfg, 1500))
+        st = jax.block_until_ready(run(make_init(wl, cfg)(
+            np.arange(32, dtype=np.uint64))))
+        raw = BatchHistory.from_state(st)
+        assert (raw.drop > 0).all()
+        assert (raw.count == 6).all()  # stored prefix, never more
+        with pytest.raises(HistoryError, match="overflow"):
+            raw.ops(0)
+        assert len(raw.ops(0, strict=False)) <= 6
+
+
+class TestLostWriteMutant:
+    def test_history_checker_catches_what_final_state_misses(self):
+        # THE point of the subsystem (ISSUE acceptance criterion): the
+        # seeded lost-write mutant (bug=True forgets the primary's
+        # commit point on replica rejoin; the protocol re-commits, so
+        # halt states look healthy) passes the existing final-state
+        # durability invariant on every seed, while the history checker
+        # flags the seeds whose READ landed in the regression window.
+        hinv, box = _capture(lambda h: stale_reads(h) & read_your_writes(h))
+        cfg = EngineConfig(pool_size=192, loss_p=0.05)
+        fbox = {}
+
+        def durability_probe(view):
+            # capture without folding into ok: one simulation serves
+            # both sides (the tools/check_soak.py cert-3 pattern)
+            fbox["ok"] = np.asarray(_durability(view), bool)
+            return np.ones_like(fbox["ok"])
+
+        rep_hist = search_seeds(
+            make_kvchaos(writes=W, record=True, bug=True), cfg,
+            durability_probe, n_seeds=1024, max_steps=1500,
+            history_invariant=hinv,
+        )
+        h = box["h"]
+        flagged = rep_hist.failing_seeds
+        assert flagged.size > 0, "mutant must be caught by the history check"
+        # the final-state invariant passes every seed — including the
+        # mutant's victims the history check flagged
+        assert fbox["ok"].all(), \
+            "the final-state invariant must miss the lost write entirely"
+        # and the exact checker agrees with the vectorized detector
+        for s in flagged[:3]:
+            i = int(np.searchsorted(rep_hist.seeds, s))
+            r = check_kv(h.ops(i))
+            assert not r.ok
+
+    def test_unmutated_control_is_clean(self, kv_record_report):
+        rep, h = kv_record_report
+        assert rep.failing_seeds.size == 0
+
+
+class TestRaftElectionHistory:
+    def test_election_safety_over_recorded_wins(self):
+        inv, box = _capture(
+            lambda h: election_safety(h, elect_op=OP_ELECT))
+        rep = search_seeds(
+            make_raft(record=True), EngineConfig(pool_size=48, loss_p=0.02),
+            invariant=lambda v: (v["node_state"][:, :, 0] == 2).any(axis=1),
+            n_seeds=128, max_steps=600, history_invariant=inv,
+        )
+        h = box["h"]
+        assert rep.failing_seeds.size == 0
+        assert rep.unhalted_seeds.size == 0
+        # the run halts at the first win: every seed recorded >= 1
+        assert (h.count >= 1).all()
+        assert (h.drop == 0).all()
+        # recorded winners are real node ids, keys are real terms
+        from madsim_tpu.check import COL_ARG, COL_KEY, COL_OK, COL_OP
+        v = h.valid()
+        assert (h.col(COL_OP)[v] == OP_ELECT).all()
+        assert (h.col(COL_OK)[v] == OK_OK).all()
+        assert ((h.col(COL_ARG)[v] >= 0) & (h.col(COL_ARG)[v] < 5)).all()
+        assert (h.col(COL_KEY)[v] >= 1).all()

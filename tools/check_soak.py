@@ -1,0 +1,195 @@
+"""Large-scale operation-history checker soak: workload certificates.
+
+The search soak (tools/search_soak.py) certifies *final-state*
+invariants; this soak certifies *histories* (madsim_tpu.check) — the
+FoundationDB-style workload verification. Three certificates:
+
+1. kvchaos-record, unmutated: N seeds through the vectorized detectors
+   (stale_reads + read_your_writes, one numpy pass over the batch) AND
+   the exact Wing–Gong linearizability checker per seed. Must be 0
+   violations — a clean negative-result artifact.
+2. raft-record: election-safety over every recorded win. Must be 0.
+3. kvchaos-bug, the seeded lost-write mutant (primary forgets its
+   commit point on replica rejoin; the protocol re-commits, so every
+   final state looks healthy): the history checkers MUST flag seeds,
+   the existing final-state durability invariant MUST pass all of them
+   — proving the subsystem detects a bug class final-state checks
+   cannot.
+
+Usage: python tools/check_soak.py [n_seeds] > CHECK_HIST_r06.txt
+Exit 0 iff all three certificates hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu.check import (  # noqa: E402
+    check_kv,
+    election_safety,
+    read_your_writes,
+    stale_reads,
+)
+from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
+from madsim_tpu.models import make_kvchaos, make_raft  # noqa: E402
+from madsim_tpu.models.raft import OP_ELECT  # noqa: E402
+
+W = 10  # kvchaos writes (the search-soak shape): 4W history records/seed
+
+
+def kv_history_invariant(box):
+    def inv(h):
+        box["h"] = h
+        box["ok"] = stale_reads(h) & read_your_writes(h)
+        return box["ok"]
+
+    return inv
+
+
+# the existing final-state invariant — the control the mutant
+# certificate is measured against; single copy, pinned to writes=10
+# (hence W above must stay 10)
+from search_soak import kvchaos_durability  # noqa: E402
+
+
+def lin_sweep(h, n_cap=None) -> list:
+    """Exact Wing–Gong pass over per-seed histories; returns the
+    violating seed indices. ~tens of ops per seed -> microseconds
+    each."""
+    n = h.n_seeds if n_cap is None else min(h.n_seeds, n_cap)
+    drop = np.asarray(h.drop)
+    bad = []
+    for s in range(n):
+        if drop[s] > 0:
+            continue  # already counted/quarantined as an overflow
+        if not check_kv(h.ops(s)).ok:
+            bad.append(s)
+    return bad
+
+
+def main() -> None:
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    cfg = EngineConfig(pool_size=192, loss_p=0.05)
+    t_all = time.monotonic()
+    failures = []
+    print(f"# operation-history checker soak: {n_seeds} schedules/cert, "
+          f"platform={jax.devices()[0].platform}")
+
+    # ---- certificate 1: unmutated kvchaos, history clean ----
+    t0 = time.monotonic()
+    box = {}
+    rep = search_seeds(
+        make_kvchaos(writes=W, record=True), cfg, None,
+        n_seeds=n_seeds, max_steps=3000,
+        history_invariant=kv_history_invariant(box),
+    )
+    h = box["h"]
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    nl = len(lin_sweep(h))
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    t_lin = time.monotonic() - t0
+    print(f"kvchaos-record: {n_seeds} schedules, {nv} vectorized "
+          f"violations, {nl} linearizability violations, {no} overflows, "
+          f"{nh} unhalted ({t_lin:.1f}s incl. {n_seeds} Wing-Gong checks)")
+    if nv or nl or no or nh:
+        failures.append("kvchaos-record")
+
+    # ---- certificate 2: raft election safety over recorded wins ----
+    t0 = time.monotonic()
+    box = {}
+
+    def elect_inv(h):
+        box["ok"] = election_safety(h, elect_op=OP_ELECT)
+        return box["ok"]
+
+    rep = search_seeds(
+        make_raft(record=True), EngineConfig(pool_size=48, loss_p=0.02),
+        None, n_seeds=n_seeds, max_steps=600,
+        history_invariant=elect_inv,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"raft-record: {n_seeds} schedules, {nv} election-safety "
+          f"violations, {no} overflows, {nh} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if nv or no or nh:
+        failures.append("raft-record")
+
+    # ---- certificate 3: the lost-write mutant ----
+    # flagged by the history checkers, passed by the final-state
+    # invariant: the bug class the old subsystem provably cannot see
+    t0 = time.monotonic()
+    box = {}
+    fbox = {}
+
+    def durability_probe(view):
+        # capture the final-state verdict without folding it into
+        # rep_h.ok — judged separately below, so one simulation serves
+        # both sides of the certificate
+        fbox["ok"] = np.asarray(kvchaos_durability(view), bool)
+        return np.ones_like(fbox["ok"])
+
+    rep_h = search_seeds(
+        make_kvchaos(writes=W, record=True, bug=True), cfg,
+        durability_probe, n_seeds=n_seeds, max_steps=3000,
+        history_invariant=kv_history_invariant(box),
+    )
+    h = box["h"]
+    # count from the captured verdicts, not rep_h.failing_seeds, so an
+    # unhalted seed (ok folds in require_halt) can't masquerade as a
+    # history catch or a final-state catch — unhalted is its own line
+    trusted = ~rep_h.overflowed
+    caught = ~box["ok"] & trusted
+    n_hist = int(caught.sum())
+    lin_bad = set(lin_sweep(h))
+    # "confirmed" means CONFIRMED: every seed the vectorized detectors
+    # flag must also fail the exact checker (the floor detectors are a
+    # sound under-approximation of linearizability) — a divergence is a
+    # checker regression, and the certificate must not certify it
+    unconfirmed = sorted(set(np.flatnonzero(caught).tolist()) - lin_bad)
+    n_lin = len(lin_bad)
+    # unhalted seeds are excluded here too: durability is trivially
+    # false on an unfinished run (client_done mid-run), which is not a
+    # final-state catch — the history checks above are prefix-closed,
+    # so `caught` needs no such mask
+    n_final = int((~fbox["ok"] & trusted & np.asarray(rep_h.halted)).sum())
+    nh3 = int((~np.asarray(rep_h.halted)).sum())
+    print(f"kvchaos-bug mutant: {n_seeds} schedules, {n_hist} caught by "
+          f"history check ({n_lin} confirmed by Wing-Gong), {n_final} "
+          f"caught by final-state invariant, {nh3} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if n_hist:
+        print(f"  first flagged seeds: {rep_h.seeds[caught][:5].tolist()}")
+    if n_hist == 0:
+        failures.append("mutant-not-caught")
+    if unconfirmed:
+        print(f"  UNCONFIRMED by Wing-Gong: seed indices "
+              f"{unconfirmed[:5]} (+{max(0, len(unconfirmed) - 5)} more)")
+        failures.append("vectorized-unconfirmed-by-wing-gong")
+    if nh3 != 0:
+        failures.append("mutant-unhalted")
+    if n_final != 0:
+        # the mutant is supposed to be INVISIBLE to final states; if the
+        # final-state invariant sees it, the certificate proves nothing
+        failures.append("mutant-visible-to-final-state")
+
+    verdict = "PASS" if not failures else f"FAIL ({', '.join(failures)})"
+    print(f"# verdict: {verdict} — history checkers catch the lost-write "
+          f"bug class; final-state invariants do not")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

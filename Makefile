@@ -24,7 +24,7 @@ SHELL   := /bin/bash
 # bash, not sh: the tier1 recipe uses `set -o pipefail`/PIPESTATUS
 
 .PHONY: check check-full native test test-full tier1 determinism \
-        bench-smoke bench-tpu-snapshot clean
+        bench-smoke bench-tpu-snapshot nemesis-soak clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -62,6 +62,15 @@ bench-smoke: native
 	BENCH_CHILD=pingpong BENCH_PLATFORM=cpu BENCH_SEEDS=4 BENCH_STEPS=100 \
 	    $(PY) bench.py
 	$(PY) examples/rpc_bench.py
+
+# Plan-randomized nemesis soak (madsim_tpu.chaos): chaos amplification
+# on the kvchaos lost-write mutant, clean-model negative, ddmin shrink
+# + exact replay, raftlog under a crash-storm/gray-failure plan.
+# NEMESIS_SEEDS=8192 is the evidence-artifact scale; the default here
+# is a quicker sanity size.
+NEMESIS_SEEDS ?= 2048
+nemesis-soak:
+	$(PY) tools/nemesis_soak.py $(NEMESIS_SEEDS)
 
 # Session-start TPU capture: the TPU tunnel historically wedges
 # mid-session, so grab the round's accelerator numbers FIRST (same

@@ -1,0 +1,58 @@
+"""madsim_tpu.chaos — declarative nemesis fault plans, both modes.
+
+MadSim's pitch is *amplified* chaos: the simulator doesn't merely
+tolerate faults, it schedules them from the seed stream. Before this
+package that chaos was hand-rolled inside each model's handlers; now it
+is a layer:
+
+* **FaultPlan** (chaos/plan.py) — a declarative spec of composable fault
+  generators (crash-restart storms, pause storms, symmetric/asymmetric/
+  partial partitions, gray-failure slow links, message duplication,
+  clock skew). Compilation draws counter-based threefry randomness
+  keyed ``(seed, plan-slot)``, so each seed gets a distinct, exactly
+  reproducible fault trajectory and the whole seed batch compiles in
+  one vectorized pass.
+* **Batched execution** — ``engine.search_seeds(plan=...)`` turns the
+  compiled plan into pre-seeded event-pool rows; the new engine kinds
+  (slow-link, duplication, skew, one-way clog) carry the fault classes
+  the original engine lacked. ``(seed, config, plan)`` is the complete
+  repro key.
+* **Asyncio execution** (chaos/nemesis.py) — ``Nemesis`` drives the same
+  plan through ``Handle``/``NetSim`` hooks on the single-seed runtime:
+  the same fault trajectory in both execution modes.
+* **Shrinking** (chaos/shrink.py) — ``shrink_plan`` delta-debugs a
+  failing ``(seed, plan)`` to a locally-minimal event subset, testing
+  each ddmin round as one vmapped batch, and returns a replayable
+  ``LiteralPlan`` whose trace hash the replay reproduces exactly.
+"""
+
+from .plan import (  # noqa: F401
+    ClockSkew,
+    CrashStorm,
+    Duplicate,
+    FaultEvent,
+    FaultPlan,
+    GrayFailure,
+    LiteralPlan,
+    Partition,
+    PauseStorm,
+    kind_name,
+)
+from .nemesis import Nemesis  # noqa: F401
+from .shrink import ShrinkResult, shrink_plan  # noqa: F401
+
+__all__ = [
+    "ClockSkew",
+    "CrashStorm",
+    "Duplicate",
+    "FaultEvent",
+    "FaultPlan",
+    "GrayFailure",
+    "LiteralPlan",
+    "Nemesis",
+    "Partition",
+    "PauseStorm",
+    "ShrinkResult",
+    "kind_name",
+    "shrink_plan",
+]

@@ -1,0 +1,655 @@
+"""Declarative nemesis fault plans, compiled per seed.
+
+The reference ecosystem hand-rolls chaos inside each test (a kill here,
+a clog there — madsim's tests and every model in madsim_tpu/models did
+the same inside their ``on_init``). A :class:`FaultPlan` lifts that into
+a declarative layer every workload gets for free: a tuple of composable
+fault *specs* — crash-restart storms, pause storms, partitions
+(symmetric, asymmetric, partial), gray failures (per-link latency
+multipliers), message duplication, per-node clock skew — each of which
+compiles, for any seed, into a concrete list of timed fault events.
+
+Randomization is counter-based, exactly like the engine's RNG
+(engine/rng.py): every draw is ``threefry2x32(seed, draw-index,
+PURPOSE_PLAN + plan-slot)`` — a pure function of its coordinates, so
+
+* each **seed** gets a distinct, exactly reproducible fault trajectory
+  (the BatchRNG varying-parameter-stream shape: one logical stream per
+  (seed, plan-slot) pair, no serial state anywhere);
+* compilation is a vectorized numpy pass over the whole seed batch
+  (``compile_batch``), feeding the batched engine's pre-seeded pool rows
+  (``engine.make_init(plan_slots=...)``);
+* the same plan drives the single-seed asyncio runtime byte-identically
+  at the event level (chaos/nemesis.py) — dual-mode parity.
+
+``(seed, config, plan)`` is a complete repro key: the plan participates
+in the search banner via :meth:`FaultPlan.hash`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from ..engine.core import (
+    KIND_CLOG,
+    KIND_CLOG_1W,
+    KIND_DUP_OFF,
+    KIND_DUP_ON,
+    KIND_KILL,
+    KIND_PAUSE,
+    KIND_RESTART,
+    KIND_RESUME,
+    KIND_SKEW,
+    KIND_SLOW_LINK,
+    KIND_UNCLOG,
+    KIND_UNCLOG_1W,
+    KIND_UNSLOW,
+    PlanRows,
+    pack_slow_arg,
+    unpack_slow_arg,
+)
+from ..engine.rng import PURPOSE_PLAN, chance_threshold
+
+__all__ = [
+    "FaultEvent",
+    "FaultPlan",
+    "LiteralPlan",
+    "CrashStorm",
+    "PauseStorm",
+    "Partition",
+    "GrayFailure",
+    "Duplicate",
+    "ClockSkew",
+    "kind_name",
+]
+
+_KIND_NAMES = {
+    KIND_KILL: "kill",
+    KIND_RESTART: "restart",
+    KIND_PAUSE: "pause",
+    KIND_RESUME: "resume",
+    KIND_CLOG: "clog",
+    KIND_UNCLOG: "unclog",
+    KIND_CLOG_1W: "clog-1w",
+    KIND_UNCLOG_1W: "unclog-1w",
+    KIND_SLOW_LINK: "slow",
+    KIND_UNSLOW: "unslow",
+    KIND_DUP_ON: "dup-on",
+    KIND_DUP_OFF: "dup-off",
+    KIND_SKEW: "skew",
+}
+
+
+def kind_name(kind: int) -> str:
+    return _KIND_NAMES.get(kind, f"kind{kind}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One concrete injected fault: an engine event at an absolute time."""
+
+    t: int  # ns from simulation start
+    kind: int  # engine / extended-chaos kind id
+    a0: int = 0
+    a1: int = 0
+
+    def __str__(self) -> str:
+        name = kind_name(self.kind)
+        ms = self.t / 1e6
+        if self.kind in (KIND_SLOW_LINK, KIND_UNSLOW):
+            b, mult = unpack_slow_arg(self.a1)
+            peer = f"n{b}" if b >= 0 else "*"
+            return f"{ms:8.2f}ms {name} n{self.a0}<->{peer} x{max(mult, 1)}"
+        if self.kind in (KIND_CLOG, KIND_UNCLOG):
+            return f"{ms:8.2f}ms {name} n{self.a0}<->n{self.a1}"
+        if self.kind in (KIND_CLOG_1W, KIND_UNCLOG_1W):
+            return f"{ms:8.2f}ms {name} n{self.a0}->n{self.a1}"
+        if self.kind == KIND_SKEW:
+            return f"{ms:8.2f}ms {name} n{self.a0} {self.a1}ns"
+        if self.kind in (KIND_DUP_ON, KIND_DUP_OFF):
+            return f"{ms:8.2f}ms {name}"
+        return f"{ms:8.2f}ms {name} n{self.a0}"
+
+
+# ---------------------------------------------------------------------------
+# counter-based plan randomness (vectorized numpy threefry)
+# ---------------------------------------------------------------------------
+
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+_PARITY = np.uint32(0x1BD11BDA)
+
+
+def _vthreefry(k0, k1, x0, x1):
+    """Array form of engine.rng.np_threefry2x32 (same function, ufunc
+    ops instead of scalar casts so the whole seed batch goes at once)."""
+    k0 = np.asarray(k0, np.uint32)
+    k1 = np.asarray(k1, np.uint32)
+    x0 = np.asarray(x0, np.uint32)
+    x1 = np.asarray(x1, np.uint32)
+    with np.errstate(over="ignore"):
+        ks = (k0, k1, (k0 ^ k1 ^ _PARITY).astype(np.uint32))
+        x0 = (x0 + ks[0]).astype(np.uint32)
+        x1 = (x1 + ks[1]).astype(np.uint32)
+        for chunk in range(5):
+            rots = _ROTATIONS[:4] if chunk % 2 == 0 else _ROTATIONS[4:]
+            for r in rots:
+                x0 = (x0 + x1).astype(np.uint32)
+                x1 = ((x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r))).astype(
+                    np.uint32
+                )
+                x1 = (x1 ^ x0).astype(np.uint32)
+            x0 = (x0 + ks[(chunk + 1) % 3]).astype(np.uint32)
+            x1 = (x1 + ks[(chunk + 2) % 3] + np.uint32(chunk + 1)).astype(
+                np.uint32
+            )
+    return x0, x1
+
+
+class _Stream:
+    """The (seed, plan-slot) draw stream: ``bits(j)`` is draw j of this
+    slot for every seed at once — order-independent coordinates, same
+    discipline as the engine's per-event draws."""
+
+    def __init__(self, seeds: np.ndarray, slot: int):
+        seeds = np.asarray(seeds, np.uint64)
+        self._k0 = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        self._k1 = (seeds >> np.uint64(32)).astype(np.uint32)
+        self._x1 = np.uint32((PURPOSE_PLAN + slot) & 0xFFFFFFFF)
+
+    def bits(self, j: int) -> np.ndarray:
+        a, _ = _vthreefry(self._k0, self._k1, np.uint32(j), self._x1)
+        return a
+
+    def uniform(self, lo: int, hi: int, j: int) -> np.ndarray:
+        """Uniform int64 in [lo, hi) — the engine's modulo reduction."""
+        span = np.uint32(max(int(hi) - int(lo), 1))
+        return np.int64(lo) + (self.bits(j) % span).astype(np.int64)
+
+    def pick(self, options, j: int) -> np.ndarray:
+        opts = np.asarray(options, np.int64)
+        return opts[self.bits(j) % np.uint32(len(opts))]
+
+    def chance(self, p: float, j: int) -> np.ndarray:
+        thresh = chance_threshold(p)
+        if thresh >= (1 << 32):
+            return np.ones(self._k0.shape, bool)
+        return self.bits(j) < np.uint32(thresh)
+
+
+# ---------------------------------------------------------------------------
+# fault specs
+# ---------------------------------------------------------------------------
+
+
+def _empty(s: int, p: int):
+    return (
+        np.zeros((s, p), np.int64),
+        np.zeros((s, p), np.int32),
+        np.zeros((s, p, 2), np.int32),
+        np.zeros((s, p), bool),
+    )
+
+
+def _check_window(lo: int, hi: int, what: str) -> None:
+    if not 0 <= lo <= hi:
+        raise ValueError(f"{what} window [{lo}, {hi}] is invalid")
+    # draws are 32-bit (the engine's reduction discipline): a span that
+    # doesn't fit uint32 would wrap/overflow in _Stream.uniform — same
+    # constraint EngineConfig enforces on its latency ranges
+    if hi - lo >= (1 << 32):
+        raise ValueError(
+            f"{what} span {hi - lo} ns does not fit uint32 "
+            f"(max {(1 << 32) - 1} ns, ~4.29 s)"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashStorm:
+    """``n`` kill/restart pairs: each kill hits a random target node at a
+    random time in [t_min, t_max) and the victim restarts after a random
+    downtime in [down_min, down_max). Kills may overlap (two victims down
+    at once) — exactly the storm shape a majority protocol must survive."""
+
+    targets: tuple
+    n: int = 1
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    down_min_ns: int = 50_000_000
+    down_max_ns: int = 400_000_000
+
+    def __post_init__(self):
+        if not self.targets:
+            raise ValueError("CrashStorm needs at least one target node")
+        if self.n < 1:
+            raise ValueError(f"n must be >= 1, got {self.n}")
+        _check_window(self.t_min_ns, self.t_max_ns, "kill-time")
+        _check_window(self.down_min_ns, self.down_max_ns, "downtime")
+
+    _KIND_ON = KIND_KILL
+    _KIND_OFF = KIND_RESTART
+
+    @property
+    def slots(self) -> int:
+        return 2 * self.n
+
+    def compile_batch(self, seeds, slot: int):
+        s = len(seeds)
+        time, kind, args, valid = _empty(s, self.slots)
+        st = _Stream(seeds, slot)
+        for i in range(self.n):
+            who = st.pick(self.targets, 3 * i)
+            at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
+            down = st.uniform(self.down_min_ns, self.down_max_ns, 3 * i + 2)
+            time[:, 2 * i] = at
+            kind[:, 2 * i] = self._KIND_ON
+            args[:, 2 * i, 0] = who
+            valid[:, 2 * i] = True
+            time[:, 2 * i + 1] = at + down
+            kind[:, 2 * i + 1] = self._KIND_OFF
+            args[:, 2 * i + 1, 0] = who
+            valid[:, 2 * i + 1] = True
+        return time, kind, args, valid
+
+
+
+@dataclasses.dataclass(frozen=True)
+class PauseStorm(CrashStorm):
+    """CrashStorm's non-destructive sibling: pause/resume instead of
+    kill/restart — the victim keeps its state and its pending events are
+    held, the classic long-GC-stall fault."""
+
+    _KIND_ON = KIND_PAUSE
+    _KIND_OFF = KIND_RESUME
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """One network cut: a random nonempty proper subset of ``targets``
+    is separated from the rest at a random time and healed after a
+    random duration.
+
+    ``asymmetric=True`` clogs each cut edge in ONE random direction only
+    (messages flow the other way — the split-brain-inducing half-open
+    failure). ``partial_p < 1`` clogs each edge only with that
+    probability (a partial partition: some paths across the cut
+    survive, routing around the damage stays possible)."""
+
+    targets: tuple
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    dur_min_ns: int = 50_000_000
+    dur_max_ns: int = 400_000_000
+    asymmetric: bool = False
+    partial_p: float = 1.0
+
+    def __post_init__(self):
+        if len(self.targets) < 2:
+            raise ValueError("Partition needs at least two target nodes")
+        if len(self.targets) > 30:
+            raise ValueError("Partition subset draw supports <= 30 targets")
+        if not 0.0 < self.partial_p <= 1.0:
+            raise ValueError(f"partial_p must be in (0, 1], got {self.partial_p}")
+        _check_window(self.t_min_ns, self.t_max_ns, "cut-time")
+        _check_window(self.dur_min_ns, self.dur_max_ns, "cut-duration")
+
+    @property
+    def slots(self) -> int:
+        t = len(self.targets)
+        return 2 * (t * (t - 1) // 2)
+
+    def compile_batch(self, seeds, slot: int):
+        s = len(seeds)
+        time, kind, args, valid = _empty(s, self.slots)
+        st = _Stream(seeds, slot)
+        t = len(self.targets)
+        full = (1 << t) - 1
+        # nonempty proper subset: remap 32 uniform bits into [1, full-1]
+        side = 1 + (st.bits(0) % np.uint32(full - 1)).astype(np.int64)
+        at = st.uniform(self.t_min_ns, self.t_max_ns, 1)
+        dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 2)
+        clog_k = KIND_CLOG_1W if self.asymmetric else KIND_CLOG
+        unclog_k = KIND_UNCLOG_1W if self.asymmetric else KIND_UNCLOG
+        q = 0
+        for i in range(t):
+            for j in range(i + 1, t):
+                word = st.bits(3 + q)
+                crosses = ((side >> i) & 1) != ((side >> j) & 1)
+                keep = crosses
+                if self.partial_p < 1.0:
+                    keep = keep & (
+                        (word & np.uint32(0xFFFF))
+                        < np.uint32(int(self.partial_p * 0x10000))
+                    )
+                # asymmetric: bit 16 of the edge word picks the blocked
+                # direction (independent of the partial-keep low bits)
+                fwd = ((word >> np.uint32(16)) & 1).astype(bool)
+                a = np.where(
+                    fwd | (not self.asymmetric),
+                    self.targets[i],
+                    self.targets[j],
+                ).astype(np.int64)
+                b = np.where(
+                    fwd | (not self.asymmetric),
+                    self.targets[j],
+                    self.targets[i],
+                ).astype(np.int64)
+                time[:, 2 * q] = at
+                kind[:, 2 * q] = clog_k
+                args[:, 2 * q, 0] = a
+                args[:, 2 * q, 1] = b
+                valid[:, 2 * q] = keep
+                time[:, 2 * q + 1] = at + dur
+                kind[:, 2 * q + 1] = unclog_k
+                args[:, 2 * q + 1, 0] = a
+                args[:, 2 * q + 1, 1] = b
+                valid[:, 2 * q + 1] = keep
+                q += 1
+        return time, kind, args, valid
+
+
+
+@dataclasses.dataclass(frozen=True)
+class GrayFailure:
+    """``n_links`` random links turn slow (latency x mult in
+    [mult_min, mult_max]) for a random window — the gray failure of the
+    runtime-variability literature: nothing is *down*, some paths are
+    just an order of magnitude slower, which readiness-oblivious
+    protocols mistake for loss and retry into."""
+
+    targets: tuple
+    n_links: int = 1
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    dur_min_ns: int = 50_000_000
+    dur_max_ns: int = 400_000_000
+    mult_min: int = 4
+    mult_max: int = 32
+
+    def __post_init__(self):
+        if len(self.targets) < 2:
+            raise ValueError("GrayFailure needs at least two target nodes")
+        if self.n_links < 1:
+            raise ValueError(f"n_links must be >= 1, got {self.n_links}")
+        if not 1 <= self.mult_min <= self.mult_max:
+            raise ValueError(
+                f"multiplier range [{self.mult_min}, {self.mult_max}] invalid"
+            )
+        if self.mult_max >= (1 << 23):
+            raise ValueError("multiplier must fit the packed args word (<2^23)")
+        _check_window(self.t_min_ns, self.t_max_ns, "slow-time")
+        _check_window(self.dur_min_ns, self.dur_max_ns, "slow-duration")
+
+    @property
+    def slots(self) -> int:
+        return 2 * self.n_links
+
+    def compile_batch(self, seeds, slot: int):
+        s = len(seeds)
+        time, kind, args, valid = _empty(s, self.slots)
+        st = _Stream(seeds, slot)
+        t = len(self.targets)
+        opts = np.asarray(self.targets, np.int64)
+        for i in range(self.n_links):
+            ai = st.bits(5 * i) % np.uint32(t)
+            # peer drawn from the other t-1 targets: a != b always
+            bi = (ai + 1 + st.bits(5 * i + 1) % np.uint32(t - 1)) % np.uint32(t)
+            a = opts[ai]
+            b = opts[bi]
+            at = st.uniform(self.t_min_ns, self.t_max_ns, 5 * i + 2)
+            dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 5 * i + 3)
+            mult = st.uniform(self.mult_min, self.mult_max + 1, 5 * i + 4)
+            time[:, 2 * i] = at
+            kind[:, 2 * i] = KIND_SLOW_LINK
+            args[:, 2 * i, 0] = a
+            args[:, 2 * i, 1] = pack_slow_arg(b, mult)
+            valid[:, 2 * i] = True
+            time[:, 2 * i + 1] = at + dur
+            kind[:, 2 * i + 1] = KIND_UNSLOW
+            args[:, 2 * i + 1, 0] = a
+            args[:, 2 * i + 1, 1] = pack_slow_arg(b, np.int64(1))
+            valid[:, 2 * i + 1] = True
+        return time, kind, args, valid
+
+
+
+@dataclasses.dataclass(frozen=True)
+class Duplicate:
+    """Message duplication for one random window: every send delivers a
+    second copy with its own latency/loss draw. Requires the engine's
+    ``dup_rows`` path, which search/shrink enable automatically when a
+    plan contains this spec."""
+
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    dur_min_ns: int = 50_000_000
+    dur_max_ns: int = 400_000_000
+
+    def __post_init__(self):
+        _check_window(self.t_min_ns, self.t_max_ns, "dup-time")
+        _check_window(self.dur_min_ns, self.dur_max_ns, "dup-duration")
+
+    @property
+    def slots(self) -> int:
+        return 2
+
+    def compile_batch(self, seeds, slot: int):
+        s = len(seeds)
+        time, kind, args, valid = _empty(s, self.slots)
+        st = _Stream(seeds, slot)
+        at = st.uniform(self.t_min_ns, self.t_max_ns, 0)
+        dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 1)
+        time[:, 0] = at
+        kind[:, 0] = KIND_DUP_ON
+        valid[:, 0] = True
+        time[:, 1] = at + dur
+        kind[:, 1] = KIND_DUP_OFF
+        valid[:, 1] = True
+        return time, kind, args, valid
+
+
+
+@dataclasses.dataclass(frozen=True)
+class ClockSkew:
+    """``n`` random nodes get a random clock skew (what their handlers
+    observe as ``ctx.now``; the asyncio runtime skews ``SystemTime``).
+    Skews persist to the end of the run — drifted clocks don't heal
+    themselves."""
+
+    targets: tuple
+    n: int = 1
+    t_min_ns: int = 0
+    t_max_ns: int = 100_000_000
+    skew_min_ns: int = -500_000_000
+    skew_max_ns: int = 500_000_000
+
+    def __post_init__(self):
+        if not self.targets:
+            raise ValueError("ClockSkew needs at least one target node")
+        if self.n < 1:
+            raise ValueError(f"n must be >= 1, got {self.n}")
+        if self.skew_min_ns > self.skew_max_ns:
+            raise ValueError("skew range is empty")
+        # strict lower bound: the span (max+1 - min) must also fit the
+        # uint32 draw reduction, which -2^31..2^31-1 would overflow
+        lim = 2**31
+        if not (-lim < self.skew_min_ns and self.skew_max_ns < lim):
+            raise ValueError("skew must fit int32 nanoseconds (~±2.1 s)")
+        _check_window(self.t_min_ns, self.t_max_ns, "skew-time")
+
+    @property
+    def slots(self) -> int:
+        return self.n
+
+    def compile_batch(self, seeds, slot: int):
+        s = len(seeds)
+        time, kind, args, valid = _empty(s, self.slots)
+        st = _Stream(seeds, slot)
+        for i in range(self.n):
+            who = st.pick(self.targets, 3 * i)
+            at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
+            skew = st.uniform(self.skew_min_ns, self.skew_max_ns + 1, 3 * i + 2)
+            time[:, i] = at
+            kind[:, i] = KIND_SKEW
+            args[:, i, 0] = who
+            args[:, i, 1] = skew
+            valid[:, i] = True
+        return time, kind, args, valid
+
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+
+def _validate_targets(specs, wl) -> None:
+    n = wl.n_nodes
+    for spec in specs:
+        for node in getattr(spec, "targets", ()):
+            if not 0 <= int(node) < n:
+                raise ValueError(
+                    f"{type(spec).__name__} targets node {node}, but "
+                    f"workload {wl.name!r} has n_nodes={n}"
+                )
+
+
+class _PlanBase:
+    """Shared surface of FaultPlan and LiteralPlan (what search/shrink
+    consume): ``slots``, ``uses_dup()``, ``hash()``, ``compile_batch``,
+    ``compile``."""
+
+    def compile(self, seed: int) -> list[FaultEvent]:
+        """The concrete fault trajectory of one seed, in slot order."""
+        rows = self.compile_batch(np.asarray([seed], np.uint64))
+        out = []
+        for j in range(rows.time.shape[1]):
+            if bool(rows.valid[0, j]):
+                out.append(
+                    FaultEvent(
+                        t=int(rows.time[0, j]),
+                        kind=int(rows.kind[0, j]),
+                        a0=int(rows.args[0, j, 0]),
+                        a1=int(rows.args[0, j, 1]),
+                    )
+                )
+        return out
+
+    def describe(self, seed: int) -> str:
+        lines = [f"plan {self.hash()} @ seed {seed}:"]
+        lines += [f"  {ev}" for ev in sorted(self.compile(seed), key=lambda e: e.t)]
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan(_PlanBase):
+    """A declarative nemesis: a tuple of fault specs, compiled per seed.
+
+    ::
+
+        plan = FaultPlan((
+            CrashStorm(targets=(1, 2, 3, 4), n=2),
+            GrayFailure(targets=(0, 1, 2, 3, 4)),
+        ))
+        report = search_seeds(wl, cfg, inv, plan=plan, ...)
+        print(plan.describe(int(report.failing_seeds[0])))
+    """
+
+    specs: tuple
+    name: str = "nemesis"
+
+    def __post_init__(self):
+        if not self.specs:
+            raise ValueError("FaultPlan needs at least one fault spec")
+
+    @property
+    def slots(self) -> int:
+        return sum(s.slots for s in self.specs)
+
+    def uses_dup(self) -> bool:
+        return any(isinstance(s, Duplicate) for s in self.specs)
+
+    def hash(self) -> str:
+        """Stable hex id of the plan (EngineConfig.hash analog): the
+        spec tuple fully determines every compiled trajectory."""
+        return hashlib.sha256(repr(self.specs).encode()).hexdigest()[:16]
+
+
+    def compile_batch(self, seeds, wl=None) -> PlanRows:
+        """Compile the whole seed batch to engine pool rows (S, slots).
+
+        Spec ``i`` draws from plan slots ``[offset_i, offset_i +
+        spec.slots)``, so adding a spec never re-randomizes the ones
+        before it."""
+        if wl is not None:
+            _validate_targets(self.specs, wl)
+        seeds = np.asarray(seeds, np.uint64)
+        parts = []
+        off = 0
+        for spec in self.specs:
+            parts.append(spec.compile_batch(seeds, off))
+            off += spec.slots
+        return PlanRows(
+            time=np.concatenate([p[0] for p in parts], axis=1),
+            kind=np.concatenate([p[1] for p in parts], axis=1),
+            args=np.concatenate([p[2] for p in parts], axis=1),
+            valid=np.concatenate([p[3] for p in parts], axis=1),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LiteralPlan(_PlanBase):
+    """An explicit, seed-independent event list — the replayable form the
+    shrinker emits.
+
+    ``enabled`` masks individual slots while keeping the pool layout (and
+    therefore the trajectory, including argmin tie-breaks on equal event
+    times) identical to the run that was shrunk: a disabled slot stays
+    reserved-but-invalid exactly as it was during ddmin. ``compile``
+    returns only the enabled events."""
+
+    events: tuple
+    enabled: tuple = ()
+    name: str = "literal"
+
+    def __post_init__(self):
+        if self.enabled and len(self.enabled) != len(self.events):
+            raise ValueError("enabled mask length must match events")
+
+    @property
+    def slots(self) -> int:
+        return len(self.events)
+
+    def _mask(self) -> np.ndarray:
+        if self.enabled:
+            return np.asarray(self.enabled, bool)
+        return np.ones((len(self.events),), bool)
+
+    def uses_dup(self) -> bool:
+        return any(
+            e.kind in (KIND_DUP_ON, KIND_DUP_OFF)
+            for e, on in zip(self.events, self._mask())
+            if on
+        )
+
+    def hash(self) -> str:
+        payload = repr((self.events, tuple(self._mask().tolist())))
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+    def compile_batch(self, seeds, wl=None) -> PlanRows:
+        seeds = np.asarray(seeds, np.uint64)
+        s, p = len(seeds), len(self.events)
+        time = np.array([e.t for e in self.events], np.int64)
+        kind = np.array([e.kind for e in self.events], np.int32)
+        args = np.array([(e.a0, e.a1) for e in self.events], np.int32).reshape(
+            p, 2
+        )
+        return PlanRows(
+            time=np.broadcast_to(time, (s, p)).copy(),
+            kind=np.broadcast_to(kind, (s, p)).copy(),
+            args=np.broadcast_to(args, (s, p, 2)).copy(),
+            valid=np.broadcast_to(self._mask(), (s, p)).copy(),
+        )

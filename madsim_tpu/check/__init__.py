@@ -46,6 +46,7 @@ from .recorder import Recorder  # noqa: F401
 from .vectorized import (  # noqa: F401
     election_safety,
     monotonic_reads,
+    monotonic_reads_strict,
     read_your_writes,
     stale_reads,
 )
@@ -71,6 +72,7 @@ __all__ = [
     "check_register",
     "election_safety",
     "monotonic_reads",
+    "monotonic_reads_strict",
     "read_your_writes",
     "stale_reads",
 ]

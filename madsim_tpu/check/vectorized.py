@@ -15,6 +15,9 @@ Scope (documented assumptions, not silent ones):
   int32 versions (kvchaos: the write seq). "Fresher" is then decidable
   per-record without a search. Non-versioned histories belong to the
   linearizability checker.
+* ``monotonic_reads`` is invoke-interval aware (pipelined reads that
+  legally complete out of order are tolerated); the response-order pass
+  survives as the opt-in ``monotonic_reads_strict``.
 * **FIFO invoke/response pairing** per (client, op, key), exact for
   clients with one outstanding op per key (all in-repo models) — same
   rule and same caveat as ``BatchHistory.ops``.
@@ -42,6 +45,7 @@ from .history import (
 
 __all__ = [
     "monotonic_reads",
+    "monotonic_reads_strict",
     "read_your_writes",
     "stale_reads",
     "election_safety",
@@ -62,10 +66,14 @@ def _cols(h: BatchHistory):
     )
 
 
-def monotonic_reads(h: BatchHistory, read_op: int = OP_READ) -> np.ndarray:
+def monotonic_reads_strict(h: BatchHistory, read_op: int = OP_READ) -> np.ndarray:
     """Per (client, key): successive successful read values never
-    decrease (the monotonic-reads session guarantee for versioned
-    registers). Pure response-order property — no pairing needed."""
+    decrease **in response order**. Pure response-order property — no
+    pairing needed — but UNSOUND for pipelined reads: two reads open
+    concurrently may legally complete out of order, and this pass flags
+    that. Opt-in for clients known to issue one read at a time; the
+    default :func:`monotonic_reads` is the invoke-interval-aware form
+    (the ROADMAP soundness fix)."""
     valid, op, key, arg, client, ok = _cols(h)
     m = valid & (op == read_op) & (ok == OK_OK)
     s_dim, h_dim = m.shape
@@ -140,16 +148,37 @@ def _read_floor_violations(
             )
             resp_slot = np.where(resp, resp_rank, h_dim)
             floor = floor_by_rank[rows, resp_slot]
-            # a rank-matched invoke recorded AFTER the response is not
-            # its invoke (the response is a bare/instantaneous event,
-            # history.py record convention): no floor constraint, so
-            # malformed interleavings under-flag instead of false-flag
+            inv_idx = idx_by_rank[rows, resp_slot]
+            own = np.arange(h_dim)[None, :]
+            # three response shapes, by the rank-matched invoke's index:
+            #   earlier invoke  -> floor sampled at the invoke (paired op)
+            #   NO invoke ever  -> a bare/instantaneous event (history.py
+            #     convention: invoke == response), so the floor as of its
+            #     OWN buffer position applies — writes completed before
+            #     the record are completed before the op
+            #   invoke AFTER    -> malformed interleaving; no constraint
+            #     (under-flag instead of false-flag)
             floor = np.where(
-                idx_by_rank[rows, resp_slot] <= np.arange(h_dim)[None, :],
-                floor, _MIN,
+                inv_idx <= own, floor, np.where(inv_idx == h_dim, excl, _MIN)
             )
             viol |= (resp & (arg < floor)).any(axis=1)
     return ~viol
+
+
+def monotonic_reads(h: BatchHistory, read_op: int = OP_READ) -> np.ndarray:
+    """Per (client, key): a successful read returns no older a version
+    than the newest read **by the same client completed before this read
+    was invoked** — the monotonic-reads session guarantee, invoke-
+    interval aware. Pipelined reads (several open at once on one
+    session) may legally complete out of order and are NOT flagged;
+    instantaneous read events (no invoke record) are ordered by their
+    buffer position. This is the floor construction of
+    :func:`stale_reads` with completed same-client reads as the floor
+    source, so it inherits the FIFO invoke/response pairing contract.
+    The old response-order pass survives as
+    :func:`monotonic_reads_strict` (opt-in; unsound for pipelined
+    reads)."""
+    return _read_floor_violations(h, read_op, read_op, own_writes_only=True)
 
 
 def read_your_writes(

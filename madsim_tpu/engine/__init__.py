@@ -18,28 +18,38 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .core import (  # noqa: E402,F401
+    FIRST_EXT_KIND,
     FIRST_USER_KIND,
     KIND_CLOG,
+    KIND_CLOG_1W,
     KIND_CLOG_NODE,
+    KIND_DUP_OFF,
+    KIND_DUP_ON,
     KIND_HALT,
     KIND_KILL,
     KIND_NOP,
     KIND_PAUSE,
     KIND_RESTART,
     KIND_RESUME,
+    KIND_SKEW,
+    KIND_SLOW_LINK,
     KIND_UNCLOG,
+    KIND_UNCLOG_1W,
     KIND_UNCLOG_NODE,
+    KIND_UNSLOW,
     EmitBuilder,
     Emits,
     EngineConfig,
     HandlerCtx,
     HistorySpec,
+    PlanRows,
     SimState,
     Workload,
     make_init,
     make_run,
     make_run_while,
     make_step,
+    pack_slow_arg,
     time32_eligible,
     user_kind,
 )

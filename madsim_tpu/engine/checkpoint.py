@@ -29,10 +29,11 @@ __all__ = ["save", "load"]
 _MANIFEST_KEY = "__madsim_manifest__"
 # format 2: ev_kind/ev_node/ev_src/ev_retry merged into packed ev_meta
 # (core.py byte-layout note); format 3: operation-history columns
-# (hist_word/hist_t/hist_count/hist_drop, madsim_tpu.check). Older
+# (hist_word/hist_t/hist_count/hist_drop, madsim_tpu.check); format 4:
+# extended chaos state (slow/dup/skew, madsim_tpu.chaos). Older
 # checkpoints are rejected with the designed mismatch error rather
 # than a KeyError mid-load
-_FORMAT = 3
+_FORMAT = 4
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

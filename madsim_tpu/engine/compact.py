@@ -94,6 +94,7 @@ def make_run_compacted(
     shrink: int = 4,
     min_size: int = 2048,
     fields: tuple = RESULT_FIELDS,
+    dup_rows: bool = False,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -107,7 +108,7 @@ def make_run_compacted(
     ``min_size >= n_seeds`` the program degenerates to exactly one
     while_loop — the plain ``make_run_while``.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:
         if f not in all_names:

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .rng import (
+    PURPOSE_DUP,
     PURPOSE_LATENCY,
     PURPOSE_LOSS,
     PURPOSE_POLL_COST,
@@ -76,6 +77,7 @@ __all__ = [
     "Emits",
     "EmitBuilder",
     "HandlerCtx",
+    "PlanRows",
     "KIND_KILL",
     "KIND_RESTART",
     "KIND_CLOG",
@@ -87,6 +89,16 @@ __all__ = [
     "KIND_PAUSE",
     "KIND_RESUME",
     "FIRST_USER_KIND",
+    "FIRST_EXT_KIND",
+    "KIND_SLOW_LINK",
+    "KIND_UNSLOW",
+    "KIND_DUP_ON",
+    "KIND_DUP_OFF",
+    "KIND_SKEW",
+    "KIND_CLOG_1W",
+    "KIND_UNCLOG_1W",
+    "pack_slow_arg",
+    "unpack_slow_arg",
     "user_kind",
     "make_init",
     "make_step",
@@ -147,9 +159,11 @@ def _check_meta_ranges(wl: "Workload") -> None:
         raise ValueError(
             f"n_nodes={wl.n_nodes} exceeds the meta byte range (254)"
         )
-    if FIRST_USER_KIND + len(wl.handlers) > 255:
+    if FIRST_USER_KIND + len(wl.handlers) > FIRST_EXT_KIND:
         raise ValueError(
-            f"{len(wl.handlers)} handlers exceed the meta kind byte"
+            f"{len(wl.handlers)} handlers exceed the user kind range "
+            f"[{FIRST_USER_KIND}, {FIRST_EXT_KIND}) (extended chaos "
+            f"kinds occupy {FIRST_EXT_KIND}..255)"
         )
 _TRACE_PRIME = np.uint64(0x100000001B3)
 _TRACE_MIX = np.uint64(0x9E3779B97F4A7C15)
@@ -170,6 +184,49 @@ KIND_NOP = 7
 KIND_PAUSE = 8  # args[0]=node      Handle::pause       (runtime/mod.rs:256)
 KIND_RESUME = 9  # args[0]=node     Handle::resume
 FIRST_USER_KIND = 10
+
+# Extended chaos kinds (madsim_tpu.chaos): allocated at the TOP of the
+# kind byte so every existing kind id — and therefore every existing
+# trace hash and the C++ oracle — is untouched. User handler kinds live
+# in [FIRST_USER_KIND, FIRST_EXT_KIND); anything >= FIRST_EXT_KIND is an
+# engine kind again (dispatched inline, exempt from the epoch/pause
+# gates exactly like kinds < FIRST_USER_KIND). The oracle does not
+# implement these kinds, so plan-driven runs are verified by the
+# two-run/two-layout checks, not the oracle compare.
+FIRST_EXT_KIND = 244
+KIND_SLOW_LINK = 244  # args[0]=a args[1]=pack_slow_arg(b, mult): gray
+#                       failure — multiply a<->b latency (b=-1: node a)
+KIND_UNSLOW = 245  # args[0]=a args[1]=pack_slow_arg(b, 1): restore x1
+KIND_DUP_ON = 246  # message duplication: every send also delivers a copy
+KIND_DUP_OFF = 247
+KIND_SKEW = 248  # args[0]=node args[1]=skew_ns: the node's clock reads
+#                  now+skew (what its handlers observe as ctx.now)
+KIND_CLOG_1W = 249  # args[0]=src args[1]=dst — asymmetric partition edge
+KIND_UNCLOG_1W = 250
+
+
+def pack_slow_arg(b, mult):
+    """Pack a slow-link peer + multiplier into one int32 args word:
+    low byte = peer node + 1 (0 = node-wide), bits 8.. = multiplier.
+    This function OWNS the layout (the chaos plan compiler and the
+    in-step decode both route through it / unpack_slow_arg). Works on
+    Python ints, numpy arrays (plan compilation) and traced values
+    (EmitBuilder helpers)."""
+    if isinstance(b, (int, np.integer)) and isinstance(mult, (int, np.integer)):
+        return ((int(b) + 1) & 0xFF) | (int(mult) << 8)
+    if isinstance(b, np.ndarray) or isinstance(mult, np.ndarray):
+        return ((np.asarray(b, np.int64) + 1) & 0xFF) | (
+            np.asarray(mult, np.int64) << 8
+        )
+    return (
+        (jnp.asarray(b, jnp.int32) + 1) & jnp.int32(0xFF)
+    ) | (jnp.asarray(mult, jnp.int32) << jnp.int32(8))
+
+
+def unpack_slow_arg(word: int) -> tuple:
+    """Inverse of :func:`pack_slow_arg` for host ints: (peer, mult) —
+    peer -1 means node-wide."""
+    return (int(word) & 0xFF) - 1, int(word) >> 8
 
 
 def user_kind(i: int) -> int:
@@ -326,6 +383,32 @@ class EmitBuilder:
 
     def unclog_link(self, a, b, when=True):
         self.after(0, KIND_UNCLOG, 0, (a, b), when)
+
+    def clog_link_one_way(self, src, dst, when=True):
+        """Asymmetric partition edge: block src -> dst only."""
+        self.after(0, KIND_CLOG_1W, 0, (src, dst), when)
+
+    def unclog_link_one_way(self, src, dst, when=True):
+        self.after(0, KIND_UNCLOG_1W, 0, (src, dst), when)
+
+    def slow_link(self, a, b, mult, when=True):
+        """Gray failure: multiply a<->b latency by ``mult`` (b=-1 slows
+        every link in or out of a)."""
+        self.after(0, KIND_SLOW_LINK, 0, (a, pack_slow_arg(b, mult)), when)
+
+    def unslow_link(self, a, b, when=True):
+        self.after(0, KIND_UNSLOW, 0, (a, pack_slow_arg(b, 1)), when)
+
+    def dup_on(self, when=True):
+        """Start duplicating messages (needs make_step(dup_rows=True))."""
+        self.after(0, KIND_DUP_ON, 0, (), when)
+
+    def dup_off(self, when=True):
+        self.after(0, KIND_DUP_OFF, 0, (), when)
+
+    def set_skew(self, node, skew_ns, when=True):
+        """Set the node's clock skew: its handlers observe now+skew_ns."""
+        self.after(0, KIND_SKEW, 0, (node, skew_ns), when)
 
     def halt(self, when=True):
         self.after(0, KIND_HALT, 0, (), when)
@@ -588,6 +671,12 @@ class SimState:
     node_state: jnp.ndarray  # (N,U) int32
     # network
     clog: jnp.ndarray  # (N,N) bool — link-clog matrix (net/mod.rs:157-216)
+    # extended chaos state (madsim_tpu.chaos; defaults are the identity,
+    # so workloads that never emit the extended kinds are bit-identical
+    # to the pre-chaos engine)
+    slow: jnp.ndarray  # (N,N) int32 — per-link latency multiplier (1 = normal)
+    dup: jnp.ndarray  # () bool — message duplication on
+    skew: jnp.ndarray  # (N,) int32 — per-node clock skew, ns (ctx.now offset)
     # operation history (madsim_tpu.check), H = HistorySpec.capacity
     # (0 when Workload.history is None). Rows are append-ordered by
     # dispatch time; hist_drop counts records lost to a full buffer —
@@ -647,17 +736,50 @@ def _resolve_time32(wl: Workload, cfg: EngineConfig, time32: bool | None) -> boo
     return bool(time32)
 
 
-def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PlanRows:
+    """Per-seed fault-plan events, compiled to pre-seeded event-pool rows.
+
+    Produced host-side by ``madsim_tpu.chaos`` (FaultPlan.compile_batch)
+    and consumed by the ``init`` built with ``make_init(plan_slots=P)``:
+    slot ``j`` of seed ``s`` becomes pool row ``n_nodes + j`` — an engine
+    (or extended-chaos) event at the given absolute time. Invalid rows
+    are skipped, so the per-seed event *count* may vary under one static
+    ``P``. Times must respect the time32 horizon when that representation
+    is active (the chaos compiler validates this).
+    """
+
+    time: jnp.ndarray  # (S, P) int64 absolute ns
+    kind: jnp.ndarray  # (S, P) int32 engine/extended kind ids
+    args: jnp.ndarray  # (S, P, 2) int32 — engine kinds read args[0:2]
+    valid: jnp.ndarray  # (S, P) bool
+
+
+def make_init(
+    wl: Workload,
+    cfg: EngineConfig,
+    time32: bool | None = None,
+    plan_slots: int = 0,
+):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
     Seeds every node with an on_init event at t=0, mirroring the builder
     running each node's init task at simulation start. ``time32`` must
     match the value resolved by :func:`make_step` (both default to the
     same automatic rule, so callers normally pass neither).
+
+    ``plan_slots=P`` reserves P pool rows per seed for a compiled fault
+    plan (madsim_tpu.chaos): the returned ``init(seeds, plan)`` then
+    requires a :class:`PlanRows` whose arrays carry the (S, P) events.
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
-    if e < n:
-        raise ValueError(f"pool_size={e} must hold at least one event per node ({n})")
+    p = plan_slots
+    if e < n + p:
+        raise ValueError(
+            f"pool_size={e} must hold one on_init event per node ({n}) "
+            f"plus the {p} fault-plan rows"
+        )
     _check_meta_ranges(wl)
     del k
     w = wl.payload_words
@@ -665,12 +787,24 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
     tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
     base_state = jnp.asarray(wl.initial_state())
 
-    def init_one(seed) -> SimState:
+    def init_one(seed, pt=None, pk=None, pa=None, pv=None) -> SimState:
         seed = jnp.asarray(seed, jnp.uint64)
         ev_valid = jnp.zeros((e,), jnp.bool_).at[:n].set(True)
         ev_kind = jnp.full((e,), KIND_NOP, jnp.int32)
         ev_kind = ev_kind.at[:n].set(FIRST_USER_KIND)
         ev_node = jnp.zeros((e,), jnp.int32).at[:n].set(jnp.arange(n, dtype=jnp.int32))
+        ev_time = jnp.zeros((e,), tdtype)
+        ev_args = jnp.zeros((e, wl.args_words), jnp.int32)
+        if p:
+            # plan rows ride slots [n, n+p): engine kinds targeting node
+            # 0 from a timer source, epoch 0 (engine kinds bypass the
+            # epoch gate). At t=0 the time32 offset form equals the
+            # absolute form, so the cast below is exact for validated
+            # plans (times within the int32 horizon).
+            ev_valid = ev_valid.at[n : n + p].set(pv)
+            ev_kind = ev_kind.at[n : n + p].set(pk)
+            ev_time = ev_time.at[n : n + p].set(pt.astype(tdtype))
+            ev_args = ev_args.at[n : n + p, 0:2].set(pa)
         # src = -1 (timer), retry = 0 for every initial on_init event
         ev_meta = _meta_pack(
             ev_kind,
@@ -687,25 +821,41 @@ def make_init(wl: Workload, cfg: EngineConfig, time32: bool | None = None):
             trace=jnp.uint64(0),
             overflow=jnp.int32(0),
             msg_count=jnp.int64(0),
-            ev_time=jnp.zeros((e,), tdtype),
+            ev_time=ev_time,
             ev_valid=ev_valid,
             ev_meta=ev_meta,
             ev_epoch=jnp.zeros((e,), jnp.int32),
-            ev_args=jnp.zeros((e, wl.args_words), jnp.int32),
+            ev_args=ev_args,
             ev_pay=jnp.zeros((e, w), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
             paused=jnp.zeros((n,), jnp.bool_),
             epoch=jnp.zeros((n,), jnp.int32),
             node_state=base_state,
             clog=jnp.zeros((n, n), jnp.bool_),
+            slow=jnp.ones((n, n), jnp.int32),
+            dup=jnp.asarray(False),
+            skew=jnp.zeros((n,), jnp.int32),
             hist_count=jnp.int32(0),
             hist_drop=jnp.int32(0),
             hist_word=jnp.zeros((h, 5), jnp.int32),
             hist_t=jnp.zeros((h,), jnp.int64),
         )
 
-    def init(seeds) -> SimState:
+    def init(seeds, plan: PlanRows | None = None) -> SimState:
         seeds = jnp.asarray(seeds, jnp.uint64)
+        if p:
+            if plan is None:
+                raise ValueError(
+                    f"init was built with plan_slots={p}; pass the "
+                    f"compiled PlanRows"
+                )
+            return jax.vmap(init_one)(
+                seeds,
+                jnp.asarray(plan.time, jnp.int64),
+                jnp.asarray(plan.kind, jnp.int32),
+                jnp.asarray(plan.args, jnp.int32),
+                jnp.asarray(plan.valid, jnp.bool_),
+            )
         return jax.vmap(init_one)(seeds)
 
     return init
@@ -740,6 +890,7 @@ def make_step(
     cfg: EngineConfig,
     layout: str | None = None,
     time32: bool | None = None,
+    dup_rows: bool = False,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -769,6 +920,14 @@ def make_step(
       :func:`time32_eligible` bounds.
     * ``False`` — absolute int64 nanoseconds, the natural CPU form.
     * ``None`` (default) — int32 on accelerators when eligible.
+
+    ``dup_rows=True`` compiles the message-duplication path (KIND_DUP_ON
+    chaos): each user emit row gets a shadow row that, while the seed's
+    ``dup`` flag is set, inserts a second delivery of every send with an
+    independent latency/loss draw (purpose PURPOSE_DUP+slot). The shadow
+    rows cost pool-placement work every step, so they are compiled only
+    when a fault plan actually uses duplication; with the flag off (or
+    ``dup`` never set) values are bit-identical to the plain step.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -915,7 +1074,9 @@ def make_step(
         args = pick_slot(st.ev_args)
         ev_epoch_i = pick_slot(st.ev_epoch)
         pay_i = pick_slot(st.ev_pay)
-        is_engine = kind < FIRST_USER_KIND
+        # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too:
+        # dispatched inline, exempt from the epoch/pause gates
+        is_engine = (kind < FIRST_USER_KIND) | (kind >= FIRST_EXT_KIND)
         is_msg = src >= 0
 
         node_ids = jnp.arange(n, dtype=jnp.int32)
@@ -927,6 +1088,7 @@ def make_step(
             alive_dst = jnp.any(st.alive & dst_oh)
             paused_dst = jnp.any(st.paused & dst_oh)
             epoch_dst = jnp.sum(jnp.where(dst_oh, st.epoch, 0)).astype(jnp.int32)
+            skew_dst = jnp.sum(jnp.where(dst_oh, st.skew, 0)).astype(jnp.int32)
         else:
             # gather lowering. Gathers clamp out-of-range indices, which
             # would silently diverge from the dense form's no-match (and
@@ -938,6 +1100,7 @@ def make_step(
             alive_dst = st.alive[dst_c] & in_range
             paused_dst = st.paused[dst_c] & in_range
             epoch_dst = jnp.where(in_range, st.epoch[dst_c], 0)
+            skew_dst = jnp.where(in_range, st.skew[dst_c], 0)
 
         # liveness/epoch gate: user events to a dead or reincarnated node
         # are dropped — the kill-drops-futures semantics of task.rs:255-276
@@ -1016,8 +1179,14 @@ def make_step(
         # computed inline as masked selects (see the branch-table note) ----
         if n_user:
             user_idx = jnp.clip(kind - FIRST_USER_KIND, 0, n_user - 1)
+            # the handler observes its NODE's clock: the true dispatch
+            # time plus the node's chaos skew (KIND_SKEW). Zero skew adds
+            # nothing, so non-chaos runs see the exact historical ctx.now.
+            # The trace fold and history timestamps keep the unskewed
+            # time (cross-node orderings stay exact).
+            user_now = now + skew_dst.astype(jnp.int64)
             operand = (
-                now, dst, state_row, args, src,
+                user_now, dst, state_row, args, src,
                 draw.k0, draw.k1, draw.step, pay_i,
             )
             user_state, uem = lax.switch(user_idx, user_branches, operand)
@@ -1077,6 +1246,41 @@ def make_step(
         clog = jnp.where(
             sel & (clog_set == 1), True, jnp.where(sel & (clog_set == 0), False, st.clog)
         )
+        # asymmetric partition edge (extended kinds): one direction only
+        is_c1w = (kind == KIND_CLOG_1W) | (kind == KIND_UNCLOG_1W)
+        c1w_set = jnp.where(
+            dispatch & is_c1w,
+            (kind == KIND_CLOG_1W).astype(jnp.int32),
+            jnp.int32(-1),
+        )
+        sel_1w = (src_ax == a0) & (dst_ax == a1)
+        clog = jnp.where(
+            sel_1w & (c1w_set == 1),
+            True,
+            jnp.where(sel_1w & (c1w_set == 0), False, clog),
+        )
+
+        # ---- extended chaos effects: gray failure / duplication / skew.
+        # Defaults (slow=1, dup off, skew=0) are identities, so these
+        # selects change no value for workloads that never emit them.
+        is_slow_kind = (kind == KIND_SLOW_LINK) | (kind == KIND_UNSLOW)
+        slow_b = (a1 & jnp.int32(0xFF)) - 1  # packed peer; -1 = node-wide
+        slow_mult = jnp.maximum(a1 >> jnp.int32(8), 1)
+        slow_mult = jnp.where(kind == KIND_UNSLOW, jnp.int32(1), slow_mult)
+        slow_set = jnp.where(
+            dispatch & is_slow_kind, slow_mult, jnp.int32(-1)
+        )
+        pair_sl = ((src_ax == a0) & (dst_ax == slow_b)) | (
+            (src_ax == slow_b) & (dst_ax == a0)
+        )
+        node_sl = (slow_b < 0) & ((src_ax == a0) | (dst_ax == a0))
+        slow = jnp.where(
+            (pair_sl | node_sl) & (slow_set > 0), slow_set, st.slow
+        )
+        is_dup_kind = (kind == KIND_DUP_ON) | (kind == KIND_DUP_OFF)
+        dup = jnp.where(dispatch & is_dup_kind, kind == KIND_DUP_ON, st.dup)
+        skew_id = jnp.where(dispatch & (kind == KIND_SKEW), a0, jnp.int32(-1))
+        skew = jnp.where(node_ids == skew_id, a1, st.skew)
 
         halted = st.halted | (dispatch & (kind == KIND_HALT)) | (has_event & over_limit)
         halt_time = jnp.where(
@@ -1103,12 +1307,33 @@ def make_step(
             rec_valid=uem.rec_valid,  # records never ride the restart row
             rec=uem.rec,
         )
-        slot_ix = jnp.arange(k + 1, dtype=jnp.uint32)  # +1: the restart row
         # one threefry block per emit slot: lane 0 = latency, lane 1 =
-        # loss (Draw.bits2) — halves the per-step block-cipher count
-        lat_bits, loss_bits = jax.vmap(
-            lambda s: draw.bits2(jnp.uint32(PURPOSE_LATENCY) + s)
-        )(slot_ix)
+        # loss (Draw.bits2) — halves the per-step block-cipher count.
+        # Under dup_rows, K shadow rows follow the restart row: copies of
+        # the user send slots, valid only while the seed's dup flag is on,
+        # drawing an INDEPENDENT latency/loss block at PURPOSE_DUP+slot —
+        # the duplicated delivery arrives at its own time and is lost on
+        # its own coin, exactly like a real duplicate in flight.
+        purposes = jnp.uint32(PURPOSE_LATENCY) + jnp.arange(
+            k + 1, dtype=jnp.uint32
+        )
+        if dup_rows:
+            dvalid = uem.valid & ~is_engine & uem.send & st.dup
+            em = Emits(
+                valid=jnp.concatenate([em.valid, dvalid]),
+                send=jnp.concatenate([em.send, uem.send]),
+                kind=jnp.concatenate([em.kind, uem.kind]),
+                dst=jnp.concatenate([em.dst, uem.dst]),
+                delay=jnp.concatenate([em.delay, uem.delay]),
+                args=jnp.concatenate([em.args, uem.args]),
+                pay=jnp.concatenate([em.pay, uem.pay]),
+                rec_valid=em.rec_valid,
+                rec=em.rec,
+            )
+            purposes = jnp.concatenate(
+                [purposes, jnp.uint32(PURPOSE_DUP) + jnp.arange(k, dtype=jnp.uint32)]
+            )
+        lat_bits, loss_bits = jax.vmap(lambda s: draw.bits2(s))(purposes)
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
         if time32:  # same value, native width (lat_max fits by eligibility)
             latency = jnp.int32(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int32)
@@ -1151,6 +1376,30 @@ def make_step(
             alive_at_dst = alive[em_dst_c] & em_in_range
             e_epoch = jnp.where(em_in_range, epoch[em_dst_c], 0)
         e_valid = e_valid & jnp.where(em.send, alive_at_dst, True)
+        # gray-failure latency multiplier: each send's latency scales by
+        # slow[sender, dst] (post-effect, like the alive gate). mult==1
+        # takes the untouched draw, so plan-free traces are unchanged.
+        if dense:
+            sender_slow = jnp.sum(
+                jnp.where(dst_oh[:, None], slow, 0), axis=0
+            ).astype(jnp.int32)  # (N,) the dispatching node's slow row
+            emit_mult = jnp.sum(
+                jnp.where(emit_dst_oh, sender_slow[None, :], 0), axis=1
+            ).astype(jnp.int32)
+        else:
+            emit_mult = jnp.where(
+                in_range & em_in_range, slow[dst_c, em_dst_c], 1
+            )
+        emit_mult = jnp.maximum(emit_mult, 1)
+        lat_scaled = latency.astype(jnp.int64) * emit_mult.astype(jnp.int64)
+        if time32:
+            # clamp to the offset horizon (the delay-over rule applied to
+            # latency): a pathological multiplier saturates loudly-visibly
+            # late rather than corrupting the int32 offset form
+            lat_scaled = jnp.minimum(
+                lat_scaled, jnp.int64(_T32_LIMIT - cfg.proc_max_ns - 1)
+            ).astype(jnp.int32)
+        latency = jnp.where(emit_mult > 1, lat_scaled.astype(latency.dtype), latency)
         if time32:
             # offsets are relative to the post-step clock, which is
             # exactly now_after — no addition needed at all
@@ -1159,7 +1408,11 @@ def make_step(
             e_time = now_after + jnp.where(em.send, latency, delay_t)
         e_src = jnp.where(em.send, dst, jnp.int32(-1))
         # engine-kind events bypass the epoch gate; keep their slot epoch 0
-        e_epoch = jnp.where(em.kind < FIRST_USER_KIND, 0, e_epoch)
+        e_epoch = jnp.where(
+            (em.kind < FIRST_USER_KIND) | (em.kind >= FIRST_EXT_KIND),
+            0,
+            e_epoch,
+        )
         # pack the four small fields into the meta word (layout at top of
         # file); kind/dst clip to the byte ranges — out-of-range values
         # already matched nothing downstream, and clipping keeps them
@@ -1185,7 +1438,8 @@ def make_step(
         msg_count = st.msg_count + jnp.sum(
             dispatch & em.valid & em.send
         ).astype(jnp.int64)
-        k1 = k + 1  # user slots + the restart row
+        # user slots + the restart row (+ the dup shadow rows when compiled)
+        k1 = int(em.valid.shape[0])
 
         if dense:
             # slot j's rank among free slots must equal the emit's rank
@@ -1301,6 +1555,9 @@ def make_step(
             epoch=epoch,
             node_state=node_state,
             clog=clog,
+            slow=slow,
+            dup=dup,
+            skew=skew,
             hist_count=hist_count,
             hist_drop=hist_drop,
             hist_word=hist_word,
@@ -1316,6 +1573,7 @@ def make_run(
     n_steps: int,
     layout: str | None = None,
     time32: bool | None = None,
+    dup_rows: bool = False,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -1331,7 +1589,7 @@ def make_run(
     check ``overflow == 0`` before trusting per-seed results (bench.py
     and engine.search do; direct callers are responsible themselves).
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -1349,6 +1607,7 @@ def make_run_while(
     max_steps: int,
     layout: str | None = None,
     time32: bool | None = None,
+    dup_rows: bool = False,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -1364,7 +1623,7 @@ def make_run_while(
     silently continues — check ``overflow == 0`` before trusting
     per-seed results.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
 
     def run(state: SimState) -> SimState:
         def cond(carry):

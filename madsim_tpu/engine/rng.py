@@ -43,6 +43,8 @@ __all__ = [
     "PURPOSE_POLL_COST",
     "PURPOSE_LATENCY",
     "PURPOSE_LOSS",
+    "PURPOSE_DUP",
+    "PURPOSE_PLAN",
     "PURPOSE_USER",
 ]
 
@@ -66,8 +68,23 @@ PURPOSE_CLOG_JITTER = 1
 # is reserved/legacy space: the engine no longer draws there, but the
 # range stays unavailable to callers so old and new layouts never alias.
 PURPOSE_LATENCY = 8  # + emit slot  (8 .. 8+K), both lanes used
-PURPOSE_LOSS = 64  # reserved (legacy per-slot loss range)
+PURPOSE_LOSS = 64  # legacy per-slot loss range, re-purposed: see PURPOSE_DUP
+# duplicated-delivery draws (chaos KIND_DUP_ON, engine/core.py dup_rows):
+# shadow emit slot s draws its independent latency/loss pair at
+# PURPOSE_DUP+s. This re-uses the retired per-slot loss range — no
+# current layout draws there, and max_emits <= 55 keeps PURPOSE_DUP+s
+# below PURPOSE_USER.
+PURPOSE_DUP = PURPOSE_LOSS
 PURPOSE_USER = 128  # + user purpose
+
+# Fault-plan compilation (madsim_tpu.chaos) also draws from this
+# threefry keyed by the instance seed, but host-side with counter
+# x0 = draw index, x1 = PURPOSE_PLAN + plan slot. PURPOSE_PLAN sits far
+# above any purpose the engine or in-repo handlers use, so plan draws
+# can never alias an in-simulation draw at the same (seed, step) — each
+# (seed, plan-slot) pair is its own reproducible stream (the BatchRNG
+# varying-parameter-stream shape).
+PURPOSE_PLAN = 0x9E370000
 
 
 def _rotl32(x, r: int):

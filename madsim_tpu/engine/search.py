@@ -37,7 +37,13 @@ import numpy as np
 import jax
 
 from .compact import RESULT_FIELDS, make_run_compacted
-from .core import EngineConfig, Workload, make_init, make_run_while
+from .core import (
+    EngineConfig,
+    Workload,
+    _resolve_time32,
+    make_init,
+    make_run_while,
+)
 
 __all__ = ["SearchReport", "search_seeds"]
 
@@ -49,15 +55,23 @@ _RUN_CACHE: dict = {}
 
 
 def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
-                  compact: bool):
-    key = (id(wl), cfg.hash(), max_steps, layout, compact)
+                  compact: bool, plan_slots: int = 0, dup_rows: bool = False):
+    # plan VALUES are runtime data (PlanRows arrays); only the slot count
+    # and the dup-path flag shape the compiled program, so one cache
+    # entry serves every plan of the same width
+    key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
+           dup_rows)
     if key not in _RUN_CACHE:
         if compact:
-            run = make_run_compacted(wl, cfg, max_steps, layout=layout)
+            run = make_run_compacted(
+                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows
+            )
         else:
-            run = jax.jit(make_run_while(wl, cfg, max_steps, layout=layout))
+            run = jax.jit(make_run_while(
+                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows
+            ))
         _RUN_CACHE[key] = (
-            make_init(wl, cfg),
+            make_init(wl, cfg, plan_slots=plan_slots),
             run,
             wl,  # keep the workload alive so id() stays unique
         )
@@ -81,6 +95,9 @@ class SearchReport:
     # lockstep loop's iteration count only for the last-halting seed
     # (per-seed values are still bit-identical between the two paths).
     steps: int
+    # fault-plan hash when the sweep ran under a chaos plan: the repro
+    # key is then (seed, config, plan) — all three printed in the banner
+    plan_hash: str = ""
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -117,10 +134,11 @@ class SearchReport:
                 f"overflowed the event pool or history buffer; excluded "
                 f"(raise pool_size / HistorySpec capacity)"
             )
+        plan = f" plan_hash={self.plan_hash}" if self.plan_hash else ""
         for s in bad[:limit]:
             lines.append(
                 f"  seed {int(s)}: rerun with seeds=[{int(s)}] "
-                f"config_hash={self.config_hash}"
+                f"config_hash={self.config_hash}{plan}"
             )
         if len(bad) > limit:
             lines.append(f"  ... and {len(bad) - limit} more")
@@ -148,6 +166,7 @@ def search_seeds(
     layout: str | None = None,
     compact: bool = False,
     history_invariant: Callable | None = None,
+    plan=None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -177,6 +196,13 @@ def search_seeds(
     (count 0, drop 0), so strict per-seed checkers
     (``BatchHistory.ops``) can run over every seed without crashing on
     one whose verdict would be discarded anyway.
+
+    ``plan`` injects a declarative fault plan (``madsim_tpu.chaos``):
+    each seed's plan compiles to its own deterministic fault trajectory
+    (pre-seeded event-pool rows), the nemesis analog of the reference's
+    hand-rolled per-model chaos. The plan hash joins the repro banner —
+    ``(seed, config, plan)`` is then the complete repro key. Requires
+    ``cfg.pool_size >= n_nodes + plan.slots``.
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
@@ -186,12 +212,34 @@ def search_seeds(
     if invariant is None and history_invariant is None:
         raise ValueError("need an invariant, a history_invariant, or both")
     seeds = np.arange(seed_base, seed_base + n_seeds, dtype=np.uint64)
-    init, run, _ = _compiled_run(wl, cfg, max_steps, layout, compact)
+    plan_slots = int(plan.slots) if plan is not None else 0
+    dup_rows = bool(plan.uses_dup()) if plan is not None else False
+    init, run, _ = _compiled_run(
+        wl, cfg, max_steps, layout, compact, plan_slots, dup_rows
+    )
+    if plan is not None:
+        rows = plan.compile_batch(seeds, wl=wl)
+        if _resolve_time32(wl, cfg, None):
+            # the compiled rows land in the int32 offset representation:
+            # a plan event past the horizon would silently wrap
+            from .core import _T32_LIMIT
+
+            lim = _T32_LIMIT - cfg.proc_max_ns - 1
+            worst = int(np.asarray(rows.time).max(initial=0))
+            if worst > lim:
+                raise ValueError(
+                    f"fault-plan event at t={worst} ns exceeds the int32 "
+                    f"time horizon ({lim} ns) active for this (workload, "
+                    f"config); shrink the plan windows or disable time32"
+                )
+        state0 = init(seeds, rows)
+    else:
+        state0 = init(seeds)
     if compact:
-        out = run(init(seeds))
+        out = run(state0)
         view = {f: getattr(out, f) for f in RESULT_FIELDS}
     else:
-        out = jax.block_until_ready(run(init(seeds)))
+        out = jax.block_until_ready(run(state0))
         view = _state_view(out)
     if invariant is not None:
         ok = np.asarray(invariant(view), dtype=bool)
@@ -246,4 +294,5 @@ def search_seeds(
         overflowed=overflowed,
         traces=view["trace"],
         steps=int(np.asarray(out.step).max()),
+        plan_hash=plan.hash() if plan is not None else "",
     )

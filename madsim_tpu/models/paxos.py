@@ -44,7 +44,16 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+from ..check.history import OP_USER
+from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
+
+# history op kind (record=True): a decide event, recorded when a
+# proposer first reaches a choosing majority AND when any proposer
+# first adopts a decision it hears — key 0 (single decree), arg = the
+# decided value. check.election_safety(h, elect_op=OP_DECIDE) is then
+# paxos agreement over every decision *observed* along the run, not
+# just the survivors' final state.
+OP_DECIDE = OP_USER
 
 _H_INIT = 0
 _H_PROPOSE = 1  # at proposer (timer): args = (tseq,)
@@ -84,8 +93,17 @@ def make_paxos(
     revive_min_ns: int = 80_000_000,
     revive_max_ns: int = 300_000_000,
     durable_acceptors: bool = False,
+    record: bool = False,
 ) -> Workload:
-    """``durable_acceptors=True`` gives every node durable columns 0-2
+    """``record=True`` turns on operation-history recording
+    (madsim_tpu.check): every decision — a proposer reaching a choosing
+    majority, and every first adoption of a DECIDED message — records an
+    instantaneous ``OP_DECIDE`` event (key 0, arg = value), so
+    ``check.election_safety`` asserts agreement over every decision
+    observed along the way (a reborn proposer adopting a *different*
+    value would be invisible to the final state once overwritten).
+
+    ``durable_acceptors=True`` gives every node durable columns 0-2
     (``Workload.durable_cols`` — the FsSim power-fail analog) and aims
     the chaos kill at an ACCEPTOR (from ``1..A-1``; acceptor 0 is the
     halt witness) instead of a proposer: classic paxos with real
@@ -242,6 +260,8 @@ def make_paxos(
         # acceptor 0 is the halt witness: its DECIDED receipt freezes
         # the instance
         eb.send(0, user_kind(_H_DECIDED), (st[P_VAL],), when=chosen)
+        if record:
+            eb.record(OP_DECIDE, key=0, arg=st[P_VAL], when=chosen)
         return new, eb.build()
 
     def on_decided(ctx):
@@ -256,6 +276,13 @@ def make_paxos(
         )
         eb = ctx.emits()
         eb.halt(when=ctx.node == jnp.int32(0))
+        if record:
+            # first adoption only (P_DEC was 0): what this proposer now
+            # believes was decided — disagreement here is the violation
+            eb.record(
+                OP_DECIDE, key=0, arg=v,
+                when=is_prop & (st[P_DEC] == jnp.int32(0)),
+            )
         return new, eb.build()
 
     def on_nack(ctx):
@@ -278,7 +305,7 @@ def make_paxos(
         return new, ctx.emits().build()
 
     return Workload(
-        name="paxos",
+        name="paxos-record" if record else "paxos",
         handler_names=(
             "init", "propose", "prepare", "promise", "accept", "accepted",
             "decided", "nack",
@@ -298,4 +325,8 @@ def make_paxos(
         args_words=3,
         # acceptor stable storage (promised, accepted_bal, accepted_val)
         durable_cols=(A_PROM, A_BAL, A_VAL) if durable_acceptors else None,
+        # decide records: <= 1 per chosen round + 1 first-adoption per
+        # proposer incarnation; 32 covers deep re-proposal chains, and
+        # overflow is loud (hist_drop) + quarantined by search_seeds
+        history=HistorySpec(capacity=32, max_records=1) if record else None,
     )

@@ -41,7 +41,23 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+from ..check.history import OP_USER
+from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
+
+# history op kinds (record=True): an election win (key = term, arg =
+# winner) and a leader commit decision (key = log index, arg = the
+# committed entry VALUE). check.election_safety over OP_ELECT is
+# at-most-one-winner-per-term; over OP_COMMIT it is raft's log
+# agreement — no index ever committed with two different values,
+# across every leader along the way (not just the logs at halt).
+# The value byte, not the full value|term<<8 entry word: the model's
+# win-time re-stamp (see module docstring) deliberately rewrites the
+# term byte of the suffix above the VOLATILE commit index, so after a
+# leader restart the same committed value is legitimately re-committed
+# under a higher term — the state machine's history is the value
+# sequence, and that is what must agree.
+OP_ELECT = OP_USER
+OP_COMMIT = OP_USER + 1
 
 _H_INIT = 0
 _H_TIMEOUT = 1  # args = (timer_seq,)
@@ -79,8 +95,18 @@ def make_raftlog(
     retx_ns: int = 60_000_000,
     chaos: bool = True,
     durable: bool = False,
+    record: bool = False,
 ) -> Workload:
-    """``durable=True`` persists exactly the columns the raft paper's
+    """``record=True`` turns on operation-history recording
+    (madsim_tpu.check): every election win records an ``OP_ELECT`` event
+    (key = term, arg = winner) and every leader commit records one
+    ``OP_COMMIT`` event per newly committed index (key = index, arg =
+    the entry word), so ``check.election_safety`` asserts both
+    at-most-one-winner-per-term and log agreement over the whole seed
+    batch — including decisions a later term's traffic overwrites in
+    the final state.
+
+    ``durable=True`` persists exactly the columns the raft paper's
     Figure 2 marks persistent — currentTerm (TERM), votedFor (VOTED,
     here the voted-in term), and the log (LOGLEN + LOG0..) — across
     kill/restart via ``Workload.durable_cols`` (the FsSim power-fail
@@ -211,6 +237,8 @@ def make_raftlog(
         _send_appends(ctx, eb, new, term, wins)
         eb.after(propose_ns, user_kind(_H_PROPOSE), ctx.node, (term,), when=wins)
         eb.after(retx_ns, user_kind(_H_RETX), ctx.node, (term,), when=wins)
+        if record:
+            eb.record(OP_ELECT, key=term, arg=ctx.node, when=wins)
         return new, eb.build()
 
     def on_append(ctx):
@@ -266,6 +294,19 @@ def make_raftlog(
         eb = ctx.emits()
         # propagate the commit index immediately
         _send_appends(ctx, eb, new, term, commit_now)
+        if record:
+            # one decision event per newly committed index (a leader
+            # with a caught-up log may commit several at once): the
+            # decided VALUE (low byte; the term byte is mutable by the
+            # re-stamp, see OP_COMMIT note) — log agreement means no
+            # index is ever recorded with two different values
+            for j in range(w):
+                eb.record(
+                    OP_COMMIT, key=j, arg=new[LOG0 + j] & jnp.int32(0xFF),
+                    when=commit_now
+                    & (jnp.int32(j) >= st[COMMIT])
+                    & (jnp.int32(j) <= idx),
+                )
         eb.halt(when=commit_now & (new[COMMIT] == jnp.int32(w)))
         return new, eb.build()
 
@@ -311,7 +352,7 @@ def make_raftlog(
         return ctx.state, eb.build()
 
     return Workload(
-        name="raftlog",
+        name="raftlog-record" if record else "raftlog",
         handler_names=("init", "timeout", "reqvote", "grant", "append", "ackapp", "propose", "retx"),
         n_nodes=n_nodes,
         state_width=width,
@@ -331,6 +372,15 @@ def make_raftlog(
         durable_cols=(
             (TERM, VOTED, LOGLEN) + tuple(LOG0 + j for j in range(w))
             if durable
+            else None
+        ),
+        # capacity sizing: elections are a handful per run even under
+        # chaos; commit records total w plus re-commits after leader
+        # changes (a new leader re-records the indices it re-confirms).
+        # Overflow is loud (hist_drop), and search_seeds quarantines it.
+        history=(
+            HistorySpec(capacity=6 * w + 24, max_records=max(w, 1))
+            if record
             else None
         ),
     )

@@ -144,6 +144,8 @@ class NetSim(Simulator):
         # unix-domain socket namespace: (node_id, path) -> bound socket.
         # Node-local IPC (paths never cross machines), wiped on reset.
         self.unix_binds: dict[tuple[int, str], object] = {}
+        # chaos: datagram duplication flag (set_duplicate)
+        self._duplicate = False
 
     # ---- Simulator lifecycle -------------------------------------------
     def create_node(self, node_id: int) -> None:
@@ -192,6 +194,28 @@ class NetSim(Simulator):
 
     def unclog_link_one_way(self, src, dst) -> None:
         self.network.unclog_link(self._nid(src), self._nid(dst))
+
+    # ---- gray failures + duplication (madsim_tpu.chaos) ----------------
+    def slow_link(self, a, b, mult: int) -> None:
+        """Gray failure: multiply a<->b latency by ``mult`` (both
+        directions, like clog_link; mult <= 1 restores). The asyncio
+        hook behind the engine's KIND_SLOW_LINK."""
+        a, b = self._nid(a), self._nid(b)
+        self.network.set_slow_link(a, b, mult)
+        self.network.set_slow_link(b, a, mult)
+
+    def unslow_link(self, a, b) -> None:
+        self.slow_link(a, b, 1)
+
+    def slow_node(self, node, mult: int) -> None:
+        """Slow every link in or out of the node (mult <= 1 restores)."""
+        self.network.set_slow_node(self._nid(node), mult)
+
+    def set_duplicate(self, on: bool) -> None:
+        """Message duplication (KIND_DUP_ON analog): while set, every
+        datagram delivery also schedules a second copy with its own
+        independent loss/latency draw."""
+        self._duplicate = bool(on)
 
     def update_config(self, f: Callable) -> None:
         """Mutate the live network config (mod.rs:131-136) — e.g.
@@ -329,21 +353,29 @@ class NetSim(Simulator):
         for hook in list(self._send_hooks.values()):
             if not hook(src_node, dst, msg):
                 return
+        deliveries = []
         res = self.network.try_send(src_node, dst, proto)
-        if res is None:
-            return
-        sock, dst_node, latency = res
-        # rsp hook captured at send, consulted at delivery time like the
-        # reference's timer closure (mod.rs:291-297)
-        rsp_hook = self._hooks_rsp.get(dst_node)
+        if res is not None:
+            deliveries.append(res)
+        if self._duplicate:
+            # duplication chaos: a second copy routed independently —
+            # its own loss coin and latency draw, like a real duplicate
+            # in flight (the engine's dup shadow rows)
+            res2 = self.network.try_send(src_node, dst, proto)
+            if res2 is not None:
+                deliveries.append(res2)
+        for sock, dst_node, latency in deliveries:
+            # rsp hook captured at send, consulted at delivery time like
+            # the reference's timer closure (mod.rs:291-297)
+            rsp_hook = self._hooks_rsp.get(dst_node)
 
-        def deliver() -> None:
-            if rsp_hook is not None and not rsp_hook(msg):
-                return
-            # visible source address: loopback stays loopback
-            sock.deliver(src_addr, dst, msg)
+            def deliver(sock=sock, rsp_hook=rsp_hook) -> None:
+                if rsp_hook is not None and not rsp_hook(msg):
+                    return
+                # visible source address: loopback stays loopback
+                sock.deliver(src_addr, dst, msg)
 
-        self.time.add_timer_at(self.time.now_ns() + latency, deliver)
+            self.time.add_timer_at(self.time.now_ns() + latency, deliver)
 
     # ---- reliable connection machinery (mod.rs:306-365) ----------------
     def register_pipe(self, pipe: Pipe) -> None:
@@ -371,7 +403,11 @@ class NetSim(Simulator):
         await self.wait_unclogged(src, dst)
         lo = round(self.config.net.send_latency[0] * NANOS_PER_SEC)
         hi = round(self.config.net.send_latency[1] * NANOS_PER_SEC)
-        latency = self.rng.randrange(lo, max(hi, lo + 1))
+        # gray failure scales the drawn latency (post-draw, so the RNG
+        # stream is identical with or without the slow link)
+        latency = self.rng.randrange(lo, max(hi, lo + 1)) * self.network.slow_mult(
+            src, dst
+        )
         fut = SimFuture(name="conn_latency")
         self.time.add_timer_at(self.time.now_ns() + latency, fut.set_result)
         await fut

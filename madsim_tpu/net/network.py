@@ -75,6 +75,14 @@ class Network:
         self._clogged_links: set[tuple[int, int]] = set()  # (src, dst) one-way
         self._clogged_in: set[int] = set()   # deliveries TO node blocked
         self._clogged_out: set[int] = set()  # sends FROM node blocked
+        # gray failures (madsim_tpu.chaos): per-link latency multipliers;
+        # absent = x1. The dict mirrors the batched engine's (N,N) `slow`
+        # matrix with OVERWRITE semantics — a node-wide set/unset writes
+        # every link touching the node, exactly like the engine's
+        # node-wide select (so the same plan yields the same multiplier
+        # state in both execution modes, including the case where a
+        # node-wide unslow wipes an earlier link-specific multiplier).
+        self._slow_links: dict[tuple[int, int], int] = {}  # (src, dst) one-way
 
     # ---- node lifecycle -------------------------------------------------
     def insert_node(self, node_id: int, ip: Optional[str]) -> None:
@@ -130,6 +138,26 @@ class Network:
 
     def unclog_link(self, src: int, dst: int) -> None:
         self._clogged_links.discard((src, dst))
+
+    def set_slow_link(self, src: int, dst: int, mult: int) -> None:
+        """Gray failure: multiply src -> dst latency by ``mult`` (one
+        direction; mult <= 1 restores normal speed)."""
+        if mult > 1:
+            self._slow_links[(src, dst)] = int(mult)
+        else:
+            self._slow_links.pop((src, dst), None)
+
+    def set_slow_node(self, node_id: int, mult: int) -> None:
+        """Set every link in or out of the node to ``mult`` (engine
+        node-wide overwrite semantics; mult <= 1 restores them all,
+        including any link-specific multiplier set earlier)."""
+        for other in self._nodes:
+            self.set_slow_link(node_id, other, mult)
+            self.set_slow_link(other, node_id, mult)
+
+    def slow_mult(self, src: int, dst: int) -> int:
+        """Effective latency multiplier for one message."""
+        return self._slow_links.get((src, dst), 1)
 
     def is_clogged(self, src: int, dst: int) -> bool:
         return (
@@ -187,7 +215,11 @@ class Network:
             return None
         lo = round(cfg.send_latency[0] * NANOS_PER_SEC)
         hi = round(cfg.send_latency[1] * NANOS_PER_SEC)
-        return self.rng.randrange(lo, max(hi, lo + 1))
+        latency = self.rng.randrange(lo, max(hi, lo + 1))
+        # gray failure: the drawn latency scales AFTER the draw, so
+        # enabling/disabling a slow link never shifts the RNG stream
+        # (determinism: the same draws happen either way)
+        return latency * self.slow_mult(src, dst)
 
     def lookup_socket(self, node_id: int, addr: SocketAddr, proto: str) -> Optional[Socket]:
         """Exact-match then 0.0.0.0-wildcard socket lookup on a node

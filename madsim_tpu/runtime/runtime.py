@@ -107,6 +107,11 @@ class Handle:
     def resume(self, node: "int | str | NodeHandle") -> None:
         self.executor.resume_node(self._node_id(node))
 
+    def set_clock_skew(self, node: "int | str | NodeHandle", skew_ns: int) -> None:
+        """Chaos: skew the node's wall clock — SystemTime.now() on that
+        node reads true time + skew_ns (madsim_tpu.chaos KIND_SKEW)."""
+        self.time.set_skew(self._node_id(node), skew_ns)
+
     def create_node(self) -> "NodeBuilder":
         return NodeBuilder(self)
 

@@ -108,7 +108,9 @@ class SystemTime:
         from . import context
 
         t = context.current_handle().time
-        return SystemTime(t.base_unix_ns + t.now_ns())
+        task = context.try_current_task()
+        skew = t.skew_of(task.node.id) if task is not None else 0
+        return SystemTime(t.base_unix_ns + t.now_ns() + skew)
 
     def timestamp(self) -> float:
         return self.unix_ns / NANOS_PER_SEC
@@ -134,9 +136,20 @@ class TimeRuntime:
         self._heap: list[tuple[int, int, Callable[[], None]]] = []
         self._seq = 0  # deterministic FIFO tiebreak for equal deadlines
         rng.now_ns = self.now_ns  # wire the determinism-log clock
+        # chaos clock skew (madsim_tpu.chaos, KIND_SKEW analog): per-node
+        # wall-clock offsets observed by SystemTime.now() on that node's
+        # tasks. The simulation clock itself (timers, sleeps) is shared —
+        # skew is what the *application* reads, the classic drifted-NTP
+        # fault; it never shifts scheduling, so determinism is untouched.
+        self.node_skew: dict[int, int] = {}
 
     def now_ns(self) -> int:
         return self._now_ns
+
+    def skew_of(self, node_id: int | None) -> int:
+        if node_id is None:
+            return 0
+        return self.node_skew.get(node_id, 0)
 
     def advance(self, delta_ns: int) -> None:
         """Advance the clock without firing timers (per-poll cost,
@@ -219,6 +232,14 @@ class TimeHandle:
 
     def now_ns(self) -> int:
         return self._rt.now_ns()
+
+    def skew_of(self, node_id: int | None) -> int:
+        return self._rt.skew_of(node_id)
+
+    def set_skew(self, node_id: int, skew_ns: int) -> None:
+        """Set the node's wall-clock skew (chaos KIND_SKEW analog):
+        SystemTime.now() on that node reads true time + skew_ns."""
+        self._rt.node_skew[node_id] = int(skew_ns)
 
     def now(self) -> Instant:
         return Instant(self._rt.now_ns())

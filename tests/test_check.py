@@ -248,6 +248,29 @@ class TestVectorized:
         ok = monotonic_reads(_hist(clean, regress, other_key))
         assert ok.tolist() == [True, False, True]
 
+    def test_monotonic_reads_tolerates_pipelined_completions(self):
+        # two reads open CONCURRENTLY may legally complete out of order:
+        # the interval-aware default must not flag, the strict opt-in
+        # pass does (the documented unsoundness it keeps)
+        from madsim_tpu.check import monotonic_reads_strict
+
+        pipelined = [
+            (OP_READ, 0, 0, 5, OK_PENDING, 0),
+            (OP_READ, 0, 0, 5, OK_PENDING, 1),
+            (OP_READ, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 1, 5, OK_OK, 20),
+        ]
+        # sequential paired reads that regress: flagged by both
+        seq_regress = [
+            (OP_READ, 0, 0, 5, OK_PENDING, 0),
+            (OP_READ, 0, 2, 5, OK_OK, 10),
+            (OP_READ, 0, 0, 5, OK_PENDING, 15),
+            (OP_READ, 0, 1, 5, OK_OK, 20),
+        ]
+        h = _hist(pipelined, seq_regress)
+        assert monotonic_reads(h).tolist() == [True, False]
+        assert monotonic_reads_strict(h).tolist() == [False, False]
+
     def test_stale_reads_lost_write(self):
         # write 2 completed before the read was invoked, read saw 1
         stale = [

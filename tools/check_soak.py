@@ -15,9 +15,14 @@ FoundationDB-style workload verification. Three certificates:
    the existing final-state durability invariant MUST pass all of them
    — proving the subsystem detects a bug class final-state checks
    cannot.
+4. raftlog-record: election safety (one winner per term) AND log
+   agreement (no index committed with two different entries) over
+   every recorded leader decision. Must be 0.
+5. paxos-record: agreement over every decide event (chooser majorities
+   and first adoptions alike). Must be 0.
 
 Usage: python tools/check_soak.py [n_seeds] > CHECK_HIST_r06.txt
-Exit 0 iff all three certificates hold.
+Exit 0 iff all five certificates hold.
 """
 
 import _bootstrap  # noqa: F401  (repo root on sys.path)
@@ -40,8 +45,16 @@ from madsim_tpu.check import (  # noqa: E402
     stale_reads,
 )
 from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
-from madsim_tpu.models import make_kvchaos, make_raft  # noqa: E402
+from madsim_tpu.models import (  # noqa: E402
+    make_kvchaos,
+    make_paxos,
+    make_raft,
+    make_raftlog,
+)
 from madsim_tpu.models.raft import OP_ELECT  # noqa: E402
+from madsim_tpu.models.raftlog import OP_COMMIT  # noqa: E402
+from madsim_tpu.models.raftlog import OP_ELECT as RL_OP_ELECT  # noqa: E402
+from madsim_tpu.models.paxos import OP_DECIDE  # noqa: E402
 
 W = 10  # kvchaos writes (the search-soak shape): 4W history records/seed
 
@@ -125,6 +138,54 @@ def main() -> None:
           f"({time.monotonic() - t0:.1f}s)")
     if nv or no or nh:
         failures.append("raft-record")
+
+    # ---- certificate 4: raftlog election safety + log agreement ----
+    t0 = time.monotonic()
+    box = {}
+
+    def raftlog_inv(h):
+        box["ok"] = election_safety(h, elect_op=RL_OP_ELECT) & election_safety(
+            h, elect_op=OP_COMMIT
+        )
+        return box["ok"]
+
+    rep = search_seeds(
+        make_raftlog(record=True),
+        EngineConfig(pool_size=64, loss_p=0.02,
+                     clog_backoff_max_ns=2_000_000_000),
+        None, n_seeds=n_seeds, max_steps=4000,
+        history_invariant=raftlog_inv,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"raftlog-record: {n_seeds} schedules, {nv} election/log-"
+          f"agreement violations, {no} overflows, {nh} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if nv or no or nh:
+        failures.append("raftlog-record")
+
+    # ---- certificate 5: paxos agreement over decide events ----
+    t0 = time.monotonic()
+    box = {}
+
+    def paxos_inv(h):
+        box["ok"] = election_safety(h, elect_op=OP_DECIDE)
+        return box["ok"]
+
+    rep = search_seeds(
+        make_paxos(record=True), EngineConfig(pool_size=64, loss_p=0.05),
+        None, n_seeds=n_seeds, max_steps=2500,
+        history_invariant=paxos_inv,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"paxos-record: {n_seeds} schedules, {nv} agreement "
+          f"violations, {no} overflows, {nh} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if nv or no or nh:
+        failures.append("paxos-record")
 
     # ---- certificate 3: the lost-write mutant ----
     # flagged by the history checkers, passed by the final-state

@@ -24,7 +24,7 @@ SHELL   := /bin/bash
 # bash, not sh: the tier1 recipe uses `set -o pipefail`/PIPESTATUS
 
 .PHONY: check check-full native test test-full tier1 determinism \
-        bench-smoke bench-tpu-snapshot nemesis-soak clean
+        bench-smoke bench-tpu-snapshot nemesis-soak explore clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -71,6 +71,15 @@ bench-smoke: native
 NEMESIS_SEEDS ?= 2048
 nemesis-soak:
 	$(PY) tools/nemesis_soak.py $(NEMESIS_SEEDS)
+
+# Coverage-guided exploration soak (madsim_tpu.explore): guided-vs-
+# uniform at equal budget on the kvchaos mutant (coverage + >=2x
+# violations), campaign determinism + replay + shrink, and the
+# targeted diskless-raftlog hunt. 2048 is the evidence-artifact scale
+# (the hunt's generation 0 lands the committed-write-loss repro there).
+EXPLORE_BUDGET ?= 2048
+explore:
+	$(PY) tools/explore_soak.py $(EXPLORE_BUDGET)
 
 # Session-start TPU capture: the TPU tunnel historically wedges
 # mid-session, so grab the round's accelerator numbers FIRST (same

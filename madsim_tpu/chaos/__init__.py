@@ -32,11 +32,14 @@ from .plan import (  # noqa: F401
     Duplicate,
     FaultEvent,
     FaultPlan,
+    FlappingPartition,
     GrayFailure,
     LiteralPlan,
     Partition,
     PauseStorm,
+    SlotTemplate,
     kind_name,
+    stack_plan_rows,
 )
 from .nemesis import Nemesis  # noqa: F401
 from .shrink import ShrinkResult, shrink_plan  # noqa: F401
@@ -47,12 +50,15 @@ __all__ = [
     "Duplicate",
     "FaultEvent",
     "FaultPlan",
+    "FlappingPartition",
     "GrayFailure",
     "LiteralPlan",
     "Nemesis",
     "Partition",
     "PauseStorm",
     "ShrinkResult",
+    "SlotTemplate",
     "kind_name",
     "shrink_plan",
+    "stack_plan_rows",
 ]

@@ -33,6 +33,8 @@ import hashlib
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..engine.core import (
     KIND_CLOG,
     KIND_CLOG_1W,
@@ -51,19 +53,27 @@ from ..engine.core import (
     pack_slow_arg,
     unpack_slow_arg,
 )
-from ..engine.rng import PURPOSE_PLAN, chance_threshold
+from ..engine.rng import (
+    PURPOSE_PLAN,
+    chance_threshold,
+    np_threefry2x32v,
+    threefry2x32,
+)
 
 __all__ = [
     "FaultEvent",
     "FaultPlan",
     "LiteralPlan",
+    "SlotTemplate",
     "CrashStorm",
     "PauseStorm",
     "Partition",
+    "FlappingPartition",
     "GrayFailure",
     "Duplicate",
     "ClockSkew",
     "kind_name",
+    "stack_plan_rows",
 ]
 
 _KIND_NAMES = {
@@ -115,37 +125,16 @@ class FaultEvent:
 
 
 # ---------------------------------------------------------------------------
-# counter-based plan randomness (vectorized numpy threefry)
+# counter-based plan randomness. One implementation, two array backends:
+# xp=np is the host path (the historical default), xp=jnp compiles the
+# whole materialization on device — 10^6-seed sweeps never ship (S, P)
+# plan arrays over PCIe. Both run the identical threefry and reduction
+# arithmetic, so the two paths are bit-identical (tests pin it).
 # ---------------------------------------------------------------------------
 
-_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
-_PARITY = np.uint32(0x1BD11BDA)
-
-
-def _vthreefry(k0, k1, x0, x1):
-    """Array form of engine.rng.np_threefry2x32 (same function, ufunc
-    ops instead of scalar casts so the whole seed batch goes at once)."""
-    k0 = np.asarray(k0, np.uint32)
-    k1 = np.asarray(k1, np.uint32)
-    x0 = np.asarray(x0, np.uint32)
-    x1 = np.asarray(x1, np.uint32)
-    with np.errstate(over="ignore"):
-        ks = (k0, k1, (k0 ^ k1 ^ _PARITY).astype(np.uint32))
-        x0 = (x0 + ks[0]).astype(np.uint32)
-        x1 = (x1 + ks[1]).astype(np.uint32)
-        for chunk in range(5):
-            rots = _ROTATIONS[:4] if chunk % 2 == 0 else _ROTATIONS[4:]
-            for r in rots:
-                x0 = (x0 + x1).astype(np.uint32)
-                x1 = ((x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r))).astype(
-                    np.uint32
-                )
-                x1 = (x1 ^ x0).astype(np.uint32)
-            x0 = (x0 + ks[(chunk + 1) % 3]).astype(np.uint32)
-            x1 = (x1 + ks[(chunk + 2) % 3] + np.uint32(chunk + 1)).astype(
-                np.uint32
-            )
-    return x0, x1
+# back-compat alias: the vectorized numpy threefry now lives in
+# engine.rng next to its scalar sibling
+_vthreefry = np_threefry2x32v
 
 
 class _Stream:
@@ -153,30 +142,42 @@ class _Stream:
     slot for every seed at once — order-independent coordinates, same
     discipline as the engine's per-event draws."""
 
-    def __init__(self, seeds: np.ndarray, slot: int):
-        seeds = np.asarray(seeds, np.uint64)
-        self._k0 = (seeds & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        self._k1 = (seeds >> np.uint64(32)).astype(np.uint32)
+    def __init__(self, seeds, slot: int, xp=np):
+        self._xp = xp
+        if xp is np:
+            seeds = np.asarray(seeds, np.uint64)
+        else:
+            seeds = jnp.asarray(seeds, jnp.uint64)
+        self._k0 = (seeds & xp.uint64(0xFFFFFFFF)).astype(xp.uint32)
+        self._k1 = (seeds >> xp.uint64(32)).astype(xp.uint32)
         self._x1 = np.uint32((PURPOSE_PLAN + slot) & 0xFFFFFFFF)
 
-    def bits(self, j: int) -> np.ndarray:
-        a, _ = _vthreefry(self._k0, self._k1, np.uint32(j), self._x1)
+    def bits(self, j: int):
+        if self._xp is np:
+            a, _ = np_threefry2x32v(self._k0, self._k1, np.uint32(j), self._x1)
+        else:
+            a, _ = threefry2x32(
+                self._k0, self._k1, jnp.uint32(j), jnp.uint32(self._x1)
+            )
         return a
 
-    def uniform(self, lo: int, hi: int, j: int) -> np.ndarray:
+    def uniform(self, lo: int, hi: int, j: int):
         """Uniform int64 in [lo, hi) — the engine's modulo reduction."""
-        span = np.uint32(max(int(hi) - int(lo), 1))
-        return np.int64(lo) + (self.bits(j) % span).astype(np.int64)
+        xp = self._xp
+        span = xp.uint32(max(int(hi) - int(lo), 1))
+        return xp.int64(lo) + (self.bits(j) % span).astype(xp.int64)
 
-    def pick(self, options, j: int) -> np.ndarray:
-        opts = np.asarray(options, np.int64)
-        return opts[self.bits(j) % np.uint32(len(opts))]
+    def pick(self, options, j: int):
+        xp = self._xp
+        opts = xp.asarray(options, xp.int64)
+        return opts[self.bits(j) % xp.uint32(len(opts))]
 
-    def chance(self, p: float, j: int) -> np.ndarray:
+    def chance(self, p: float, j: int):
+        xp = self._xp
         thresh = chance_threshold(p)
         if thresh >= (1 << 32):
-            return np.ones(self._k0.shape, bool)
-        return self.bits(j) < np.uint32(thresh)
+            return xp.ones(self._k0.shape, bool)
+        return self.bits(j) < xp.uint32(thresh)
 
 
 # ---------------------------------------------------------------------------
@@ -184,13 +185,47 @@ class _Stream:
 # ---------------------------------------------------------------------------
 
 
-def _empty(s: int, p: int):
-    return (
-        np.zeros((s, p), np.int64),
-        np.zeros((s, p), np.int32),
-        np.zeros((s, p, 2), np.int32),
-        np.zeros((s, p), bool),
-    )
+def _pack_slots(xp, s: int, rows):
+    """Stack per-slot ``(time, kind, a0, a1, valid)`` rows into the
+    (S, P[, 2]) column arrays ``compile_batch`` returns. Scalars
+    broadcast over the seed axis; works on both array backends."""
+
+    def col(v, dtype):
+        a = xp.asarray(v, dtype)
+        if a.ndim == 0:
+            a = xp.broadcast_to(a, (s,))
+        return a.astype(dtype)
+
+    time = xp.stack([col(r[0], xp.int64) for r in rows], axis=1)
+    kind = xp.stack([col(r[1], xp.int32) for r in rows], axis=1)
+    a0 = xp.stack([col(r[2], xp.int32) for r in rows], axis=1)
+    a1 = xp.stack([col(r[3], xp.int32) for r in rows], axis=1)
+    valid = xp.stack([col(r[4], xp.bool_) for r in rows], axis=1)
+    return time, kind, xp.stack([a0, a1], axis=2), valid
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotTemplate:
+    """Mutation metadata for ONE plan slot (the madsim_tpu.explore
+    hook): the window a retimed event may land in, the node set a
+    retargeted event may hit, and how its args word is drawn. Specs
+    expose one template per slot via ``slot_templates()`` so the
+    exploration mutators can perturb a compiled plan without knowing
+    any spec's internals."""
+
+    kind: int  # the slot's event kind
+    t_min_ns: int  # retime/add draw window (absolute ns)
+    t_max_ns: int
+    targets: tuple = ()  # candidate nodes (empty = args not node-valued)
+    # how retarget draws the args: "node" (a0 = one target), "pair"
+    # (a0, a1 = two distinct targets — clog/unclog edges), "slow"
+    # (a0 = node, a1 = pack_slow_arg(peer, mult)), "skew" (a0 = node,
+    # a1 = skew ns), "none" (args fixed, e.g. dup toggles)
+    arg_kind: str = "node"
+    mult_min: int = 1
+    mult_max: int = 1
+    skew_min_ns: int = 0
+    skew_max_ns: int = 0
 
 
 def _check_window(lo: int, hi: int, what: str) -> None:
@@ -235,23 +270,31 @@ class CrashStorm:
     def slots(self) -> int:
         return 2 * self.n
 
-    def compile_batch(self, seeds, slot: int):
-        s = len(seeds)
-        time, kind, args, valid = _empty(s, self.slots)
-        st = _Stream(seeds, slot)
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
+        rows = []
         for i in range(self.n):
             who = st.pick(self.targets, 3 * i)
             at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
             down = st.uniform(self.down_min_ns, self.down_max_ns, 3 * i + 2)
-            time[:, 2 * i] = at
-            kind[:, 2 * i] = self._KIND_ON
-            args[:, 2 * i, 0] = who
-            valid[:, 2 * i] = True
-            time[:, 2 * i + 1] = at + down
-            kind[:, 2 * i + 1] = self._KIND_OFF
-            args[:, 2 * i + 1, 0] = who
-            valid[:, 2 * i + 1] = True
-        return time, kind, args, valid
+            rows.append((at, self._KIND_ON, who, 0, True))
+            rows.append((at + down, self._KIND_OFF, who, 0, True))
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        out = []
+        for _ in range(self.n):
+            out.append(SlotTemplate(
+                kind=self._KIND_ON, t_min_ns=self.t_min_ns,
+                t_max_ns=self.t_max_ns, targets=self.targets,
+            ))
+            out.append(SlotTemplate(
+                kind=self._KIND_OFF,
+                t_min_ns=self.t_min_ns + self.down_min_ns,
+                t_max_ns=self.t_max_ns + self.down_max_ns,
+                targets=self.targets,
+            ))
+        return tuple(out)
 
 
 
@@ -300,55 +343,162 @@ class Partition:
         t = len(self.targets)
         return 2 * (t * (t - 1) // 2)
 
-    def compile_batch(self, seeds, slot: int):
-        s = len(seeds)
-        time, kind, args, valid = _empty(s, self.slots)
-        st = _Stream(seeds, slot)
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
         t = len(self.targets)
         full = (1 << t) - 1
         # nonempty proper subset: remap 32 uniform bits into [1, full-1]
-        side = 1 + (st.bits(0) % np.uint32(full - 1)).astype(np.int64)
+        side = 1 + (st.bits(0) % xp.uint32(full - 1)).astype(xp.int64)
         at = st.uniform(self.t_min_ns, self.t_max_ns, 1)
         dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 2)
-        clog_k = KIND_CLOG_1W if self.asymmetric else KIND_CLOG
-        unclog_k = KIND_UNCLOG_1W if self.asymmetric else KIND_UNCLOG
-        q = 0
-        for i in range(t):
-            for j in range(i + 1, t):
-                word = st.bits(3 + q)
-                crosses = ((side >> i) & 1) != ((side >> j) & 1)
-                keep = crosses
-                if self.partial_p < 1.0:
-                    keep = keep & (
-                        (word & np.uint32(0xFFFF))
-                        < np.uint32(int(self.partial_p * 0x10000))
-                    )
-                # asymmetric: bit 16 of the edge word picks the blocked
-                # direction (independent of the partial-keep low bits)
-                fwd = ((word >> np.uint32(16)) & 1).astype(bool)
-                a = np.where(
-                    fwd | (not self.asymmetric),
-                    self.targets[i],
-                    self.targets[j],
-                ).astype(np.int64)
-                b = np.where(
-                    fwd | (not self.asymmetric),
-                    self.targets[j],
-                    self.targets[i],
-                ).astype(np.int64)
-                time[:, 2 * q] = at
-                kind[:, 2 * q] = clog_k
-                args[:, 2 * q, 0] = a
-                args[:, 2 * q, 1] = b
-                valid[:, 2 * q] = keep
-                time[:, 2 * q + 1] = at + dur
-                kind[:, 2 * q + 1] = unclog_k
-                args[:, 2 * q + 1, 0] = a
-                args[:, 2 * q + 1, 1] = b
-                valid[:, 2 * q + 1] = keep
-                q += 1
-        return time, kind, args, valid
+        rows = _partition_edge_rows(
+            xp, st, self.targets, self.asymmetric, self.partial_p,
+            side, at, dur, 3,
+        )
+        return _pack_slots(xp, len(seeds), rows)
 
+    def slot_templates(self) -> tuple:
+        return _partition_slot_templates(
+            self.targets, self.asymmetric,
+            self.t_min_ns, self.t_max_ns, self.dur_min_ns, self.dur_max_ns,
+        )
+
+
+
+def _partition_edge_rows(xp, st, targets, asymmetric, partial_p,
+                         side, at, dur, draw0):
+    """Per-edge clog/unclog slot rows of one cut — shared by Partition
+    (one cut per plan) and FlappingPartition (one call per cycle).
+    Edge q draws its word at ``draw0 + q``."""
+    t = len(targets)
+    clog_k = KIND_CLOG_1W if asymmetric else KIND_CLOG
+    unclog_k = KIND_UNCLOG_1W if asymmetric else KIND_UNCLOG
+    rows = []
+    q = 0
+    for i in range(t):
+        for j in range(i + 1, t):
+            word = st.bits(draw0 + q)
+            crosses = ((side >> i) & 1) != ((side >> j) & 1)
+            keep = crosses
+            if partial_p < 1.0:
+                keep = keep & (
+                    (word & xp.uint32(0xFFFF))
+                    < xp.uint32(int(partial_p * 0x10000))
+                )
+            # asymmetric: bit 16 of the edge word picks the blocked
+            # direction (independent of the partial-keep low bits)
+            fwd = ((word >> xp.uint32(16)) & 1).astype(xp.bool_)
+            pick_fwd = fwd | (not asymmetric)
+            a = xp.where(pick_fwd, targets[i], targets[j]).astype(xp.int64)
+            b = xp.where(pick_fwd, targets[j], targets[i]).astype(xp.int64)
+            rows.append((at, clog_k, a, b, keep))
+            rows.append((at + dur, unclog_k, a, b, keep))
+            q += 1
+    return rows
+
+
+def _partition_slot_templates(targets, asymmetric, t_min, t_max,
+                              dur_min, dur_max) -> tuple:
+    t = len(targets)
+    clog_k = KIND_CLOG_1W if asymmetric else KIND_CLOG
+    unclog_k = KIND_UNCLOG_1W if asymmetric else KIND_UNCLOG
+    out = []
+    for _ in range(t * (t - 1) // 2):
+        out.append(SlotTemplate(
+            kind=clog_k, t_min_ns=t_min, t_max_ns=t_max,
+            targets=targets, arg_kind="pair",
+        ))
+        out.append(SlotTemplate(
+            kind=unclog_k, t_min_ns=t_min + dur_min, t_max_ns=t_max + dur_max,
+            targets=targets, arg_kind="pair",
+        ))
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlappingPartition:
+    """Route instability: ``n_cycles`` cut/heal cycles, each cutting a
+    FRESHLY drawn nonempty proper subset of ``targets`` — sides AND
+    timing re-randomize every cycle, the flapping-route failure a
+    single :class:`Partition` cut cannot express. Cycle 0 cuts at a
+    random time in [t_min, t_max); every cut holds for a duration in
+    [dur_min, dur_max) and the next cut follows the heal after a gap in
+    [up_min, up_max). ``asymmetric``/``partial_p`` apply per cycle,
+    exactly as in :class:`Partition`."""
+
+    targets: tuple
+    n_cycles: int = 2
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    dur_min_ns: int = 50_000_000
+    dur_max_ns: int = 300_000_000
+    up_min_ns: int = 20_000_000
+    up_max_ns: int = 200_000_000
+    asymmetric: bool = False
+    partial_p: float = 1.0
+
+    def __post_init__(self):
+        if len(self.targets) < 2:
+            raise ValueError("FlappingPartition needs at least two target nodes")
+        if len(self.targets) > 30:
+            raise ValueError(
+                "FlappingPartition subset draw supports <= 30 targets"
+            )
+        if self.n_cycles < 1:
+            raise ValueError(f"n_cycles must be >= 1, got {self.n_cycles}")
+        if not 0.0 < self.partial_p <= 1.0:
+            raise ValueError(
+                f"partial_p must be in (0, 1], got {self.partial_p}"
+            )
+        _check_window(self.t_min_ns, self.t_max_ns, "first-cut-time")
+        _check_window(self.dur_min_ns, self.dur_max_ns, "cut-duration")
+        _check_window(self.up_min_ns, self.up_max_ns, "heal-gap")
+
+    @property
+    def _edges(self) -> int:
+        t = len(self.targets)
+        return t * (t - 1) // 2
+
+    @property
+    def slots(self) -> int:
+        return self.n_cycles * 2 * self._edges
+
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
+        t = len(self.targets)
+        full = (1 << t) - 1
+        rows = []
+        heal = None
+        # each cycle's draw block: side, duration, start-offset, then
+        # one word per edge — appending a cycle never re-randomizes the
+        # ones before it (the spec-offset rule applied within the spec)
+        block = 3 + self._edges
+        for c in range(self.n_cycles):
+            base = c * block
+            side = 1 + (st.bits(base) % xp.uint32(full - 1)).astype(xp.int64)
+            dur = st.uniform(self.dur_min_ns, self.dur_max_ns, base + 1)
+            if c == 0:
+                at = st.uniform(self.t_min_ns, self.t_max_ns, base + 2)
+            else:
+                at = heal + st.uniform(self.up_min_ns, self.up_max_ns, base + 2)
+            rows += _partition_edge_rows(
+                xp, st, self.targets, self.asymmetric, self.partial_p,
+                side, at, dur, base + 3,
+            )
+            heal = at + dur
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        out = []
+        for c in range(self.n_cycles):
+            # cycle c's cut lands after c earlier (duration + gap) spans
+            lo = self.t_min_ns + c * (self.dur_min_ns + self.up_min_ns)
+            hi = self.t_max_ns + c * (self.dur_max_ns + self.up_max_ns)
+            out += _partition_slot_templates(
+                self.targets, self.asymmetric, lo, hi,
+                self.dur_min_ns, self.dur_max_ns,
+            )
+        return tuple(out)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -386,32 +536,41 @@ class GrayFailure:
     def slots(self) -> int:
         return 2 * self.n_links
 
-    def compile_batch(self, seeds, slot: int):
-        s = len(seeds)
-        time, kind, args, valid = _empty(s, self.slots)
-        st = _Stream(seeds, slot)
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
         t = len(self.targets)
-        opts = np.asarray(self.targets, np.int64)
+        opts = xp.asarray(self.targets, xp.int64)
+        one = xp.int64(1)
+        rows = []
         for i in range(self.n_links):
-            ai = st.bits(5 * i) % np.uint32(t)
+            ai = st.bits(5 * i) % xp.uint32(t)
             # peer drawn from the other t-1 targets: a != b always
-            bi = (ai + 1 + st.bits(5 * i + 1) % np.uint32(t - 1)) % np.uint32(t)
+            bi = (ai + 1 + st.bits(5 * i + 1) % xp.uint32(t - 1)) % xp.uint32(t)
             a = opts[ai]
             b = opts[bi]
             at = st.uniform(self.t_min_ns, self.t_max_ns, 5 * i + 2)
             dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 5 * i + 3)
             mult = st.uniform(self.mult_min, self.mult_max + 1, 5 * i + 4)
-            time[:, 2 * i] = at
-            kind[:, 2 * i] = KIND_SLOW_LINK
-            args[:, 2 * i, 0] = a
-            args[:, 2 * i, 1] = pack_slow_arg(b, mult)
-            valid[:, 2 * i] = True
-            time[:, 2 * i + 1] = at + dur
-            kind[:, 2 * i + 1] = KIND_UNSLOW
-            args[:, 2 * i + 1, 0] = a
-            args[:, 2 * i + 1, 1] = pack_slow_arg(b, np.int64(1))
-            valid[:, 2 * i + 1] = True
-        return time, kind, args, valid
+            rows.append((at, KIND_SLOW_LINK, a, pack_slow_arg(b, mult), True))
+            rows.append((at + dur, KIND_UNSLOW, a, pack_slow_arg(b, one), True))
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        out = []
+        for _ in range(self.n_links):
+            out.append(SlotTemplate(
+                kind=KIND_SLOW_LINK, t_min_ns=self.t_min_ns,
+                t_max_ns=self.t_max_ns, targets=self.targets,
+                arg_kind="slow", mult_min=self.mult_min,
+                mult_max=self.mult_max,
+            ))
+            out.append(SlotTemplate(
+                kind=KIND_UNSLOW,
+                t_min_ns=self.t_min_ns + self.dur_min_ns,
+                t_max_ns=self.t_max_ns + self.dur_max_ns,
+                targets=self.targets, arg_kind="slow",
+            ))
+        return tuple(out)
 
 
 
@@ -435,19 +594,28 @@ class Duplicate:
     def slots(self) -> int:
         return 2
 
-    def compile_batch(self, seeds, slot: int):
-        s = len(seeds)
-        time, kind, args, valid = _empty(s, self.slots)
-        st = _Stream(seeds, slot)
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
         at = st.uniform(self.t_min_ns, self.t_max_ns, 0)
         dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 1)
-        time[:, 0] = at
-        kind[:, 0] = KIND_DUP_ON
-        valid[:, 0] = True
-        time[:, 1] = at + dur
-        kind[:, 1] = KIND_DUP_OFF
-        valid[:, 1] = True
-        return time, kind, args, valid
+        rows = [
+            (at, KIND_DUP_ON, 0, 0, True),
+            (at + dur, KIND_DUP_OFF, 0, 0, True),
+        ]
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        return (
+            SlotTemplate(
+                kind=KIND_DUP_ON, t_min_ns=self.t_min_ns,
+                t_max_ns=self.t_max_ns, arg_kind="none",
+            ),
+            SlotTemplate(
+                kind=KIND_DUP_OFF,
+                t_min_ns=self.t_min_ns + self.dur_min_ns,
+                t_max_ns=self.t_max_ns + self.dur_max_ns, arg_kind="none",
+            ),
+        )
 
 
 
@@ -483,20 +651,26 @@ class ClockSkew:
     def slots(self) -> int:
         return self.n
 
-    def compile_batch(self, seeds, slot: int):
-        s = len(seeds)
-        time, kind, args, valid = _empty(s, self.slots)
-        st = _Stream(seeds, slot)
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
+        rows = []
         for i in range(self.n):
             who = st.pick(self.targets, 3 * i)
             at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
             skew = st.uniform(self.skew_min_ns, self.skew_max_ns + 1, 3 * i + 2)
-            time[:, i] = at
-            kind[:, i] = KIND_SKEW
-            args[:, i, 0] = who
-            args[:, i, 1] = skew
-            valid[:, i] = True
-        return time, kind, args, valid
+            rows.append((at, KIND_SKEW, who, skew, True))
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        return tuple(
+            SlotTemplate(
+                kind=KIND_SKEW, t_min_ns=self.t_min_ns,
+                t_max_ns=self.t_max_ns, targets=self.targets,
+                arg_kind="skew", skew_min_ns=self.skew_min_ns,
+                skew_max_ns=self.skew_max_ns,
+            )
+            for _ in range(self.n)
+        )
 
 
 
@@ -577,25 +751,61 @@ class FaultPlan(_PlanBase):
         return hashlib.sha256(repr(self.specs).encode()).hexdigest()[:16]
 
 
-    def compile_batch(self, seeds, wl=None) -> PlanRows:
+    def compile_batch(self, seeds, wl=None, device: bool = False) -> PlanRows:
         """Compile the whole seed batch to engine pool rows (S, slots).
 
         Spec ``i`` draws from plan slots ``[offset_i, offset_i +
         spec.slots)``, so adding a spec never re-randomizes the ones
-        before it."""
+        before it.
+
+        ``device=True`` materializes on the accelerator (jnp arrays,
+        jit/vmap-traceable): 10^6-seed sweeps compile their plans where
+        the simulation runs instead of shipping (S, P) arrays from the
+        host. Bit-identical to the numpy path (the parity test pins it).
+        """
         if wl is not None:
             _validate_targets(self.specs, wl)
-        seeds = np.asarray(seeds, np.uint64)
+        xp = jnp if device else np
+        seeds = xp.asarray(seeds, xp.uint64)
         parts = []
         off = 0
         for spec in self.specs:
-            parts.append(spec.compile_batch(seeds, off))
+            parts.append(spec.compile_batch(seeds, off, xp))
             off += spec.slots
         return PlanRows(
-            time=np.concatenate([p[0] for p in parts], axis=1),
-            kind=np.concatenate([p[1] for p in parts], axis=1),
-            args=np.concatenate([p[2] for p in parts], axis=1),
-            valid=np.concatenate([p[3] for p in parts], axis=1),
+            time=xp.concatenate([p[0] for p in parts], axis=1),
+            kind=xp.concatenate([p[1] for p in parts], axis=1),
+            args=xp.concatenate([p[2] for p in parts], axis=1),
+            valid=xp.concatenate([p[3] for p in parts], axis=1),
+        )
+
+    def slot_templates(self) -> tuple:
+        """One :class:`SlotTemplate` per plan slot, spec order — the
+        mutation surface madsim_tpu.explore perturbs."""
+        out = []
+        for spec in self.specs:
+            out += list(spec.slot_templates())
+        return tuple(out)
+
+    def literalize(self, seed: int, wl=None) -> "LiteralPlan":
+        """This seed's compiled trajectory as a :class:`LiteralPlan`
+        with the SAME pool layout: every slot is kept (invalid slots
+        become disabled-but-reserved entries), so the literal replays
+        the FaultPlan run bit-identically — the corpus-entry form of
+        madsim_tpu.explore."""
+        rows = self.compile_batch(np.asarray([seed], np.uint64), wl=wl)
+        events = tuple(
+            FaultEvent(
+                t=int(rows.time[0, j]),
+                kind=int(rows.kind[0, j]),
+                a0=int(rows.args[0, j, 0]),
+                a1=int(rows.args[0, j, 1]),
+            )
+            for j in range(rows.time.shape[1])
+        )
+        enabled = tuple(bool(x) for x in rows.valid[0])
+        return LiteralPlan(
+            events=events, enabled=enabled, name=f"{self.name}@{int(seed)}"
         )
 
 
@@ -639,17 +849,76 @@ class LiteralPlan(_PlanBase):
         return hashlib.sha256(payload.encode()).hexdigest()[:16]
 
 
-    def compile_batch(self, seeds, wl=None) -> PlanRows:
-        seeds = np.asarray(seeds, np.uint64)
+    def compile_batch(self, seeds, wl=None, device: bool = False) -> PlanRows:
+        xp = jnp if device else np
+        seeds = xp.asarray(seeds, xp.uint64)
         s, p = len(seeds), len(self.events)
-        time = np.array([e.t for e in self.events], np.int64)
-        kind = np.array([e.kind for e in self.events], np.int32)
-        args = np.array([(e.a0, e.a1) for e in self.events], np.int32).reshape(
-            p, 2
-        )
+        time = xp.asarray([e.t for e in self.events], xp.int64)
+        kind = xp.asarray([e.kind for e in self.events], xp.int32)
+        args = xp.asarray(
+            [(e.a0, e.a1) for e in self.events], xp.int32
+        ).reshape(p, 2)
+        mask = xp.asarray(self._mask()) if device else self._mask()
+        if device:
+            return PlanRows(
+                time=xp.broadcast_to(time, (s, p)),
+                kind=xp.broadcast_to(kind, (s, p)),
+                args=xp.broadcast_to(args, (s, p, 2)),
+                valid=xp.broadcast_to(mask, (s, p)),
+            )
+        # numpy rows stay writable copies: the shrinker masks them in place
         return PlanRows(
             time=np.broadcast_to(time, (s, p)).copy(),
             kind=np.broadcast_to(kind, (s, p)).copy(),
             args=np.broadcast_to(args, (s, p, 2)).copy(),
-            valid=np.broadcast_to(self._mask(), (s, p)).copy(),
+            valid=np.broadcast_to(mask, (s, p)).copy(),
         )
+
+    def to_dict(self) -> dict:
+        """JSON-ready form (the exploration corpus/artifact format)."""
+        return {
+            "name": self.name,
+            "events": [[e.t, e.kind, e.a0, e.a1] for e in self.events],
+            "enabled": [bool(x) for x in self._mask()],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LiteralPlan":
+        return cls(
+            events=tuple(
+                FaultEvent(t=int(t), kind=int(k), a0=int(a0), a1=int(a1))
+                for t, k, a0, a1 in d["events"]
+            ),
+            enabled=tuple(bool(x) for x in d.get("enabled", ())),
+            name=d.get("name", "literal"),
+        )
+
+
+def stack_plan_rows(plans) -> PlanRows:
+    """Stack per-row :class:`LiteralPlan` objects (equal slot counts)
+    into one batch: row ``i`` of the returned :class:`PlanRows` carries
+    ``plans[i]``. This is the heterogeneous form a mutated exploration
+    generation needs — ``compile_batch`` broadcasts ONE plan over every
+    seed, while here every seed runs its own mutant."""
+    if not plans:
+        raise ValueError("stack_plan_rows needs at least one plan")
+    p = plans[0].slots
+    for pl in plans:
+        if pl.slots != p:
+            raise ValueError(
+                f"all plans must share one slot count; got {pl.slots} != {p}"
+            )
+    return PlanRows(
+        time=np.array(
+            [[e.t for e in pl.events] for pl in plans], np.int64
+        ).reshape(len(plans), p),
+        kind=np.array(
+            [[e.kind for e in pl.events] for pl in plans], np.int32
+        ).reshape(len(plans), p),
+        args=np.array(
+            [[(e.a0, e.a1) for e in pl.events] for pl in plans], np.int32
+        ).reshape(len(plans), p, 2),
+        valid=np.array([pl._mask() for pl in plans], bool).reshape(
+            len(plans), p
+        ),
+    )

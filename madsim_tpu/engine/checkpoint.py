@@ -30,10 +30,11 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # format 2: ev_kind/ev_node/ev_src/ev_retry merged into packed ev_meta
 # (core.py byte-layout note); format 3: operation-history columns
 # (hist_word/hist_t/hist_count/hist_drop, madsim_tpu.check); format 4:
-# extended chaos state (slow/dup/skew, madsim_tpu.chaos). Older
+# extended chaos state (slow/dup/skew, madsim_tpu.chaos); format 5:
+# coverage fingerprint (cov/cov_last, madsim_tpu.explore). Older
 # checkpoints are rejected with the designed mismatch error rather
 # than a KeyError mid-load
-_FORMAT = 4
+_FORMAT = 5
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

@@ -75,6 +75,8 @@ RESULT_FIELDS = (
     "hist_drop",
     "hist_word",
     "hist_t",
+    # coverage bitmap (madsim_tpu.explore): zero-size with cov_words=0
+    "cov",
 )
 
 
@@ -95,6 +97,7 @@ def make_run_compacted(
     min_size: int = 2048,
     fields: tuple = RESULT_FIELDS,
     dup_rows: bool = False,
+    cov_words: int = 0,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -108,7 +111,7 @@ def make_run_compacted(
     ``min_size >= n_seeds`` the program degenerates to exactly one
     while_loop — the plain ``make_run_while``.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:
         if f not in all_names:

@@ -686,6 +686,15 @@ class SimState:
     hist_drop: jnp.ndarray  # () int32 records dropped at capacity
     hist_word: jnp.ndarray  # (H,5) int32 [op, key, arg, client, ok]
     hist_t: jnp.ndarray  # (H,) int64 record sim-time ns (absolute)
+    # coverage fingerprint (madsim_tpu.explore), CW = the cov_words
+    # build parameter (0 = off, zero-size arrays, zero step cost). Each
+    # dispatched event folds features — per-node event-kind transition
+    # pairs, engine/chaos kind x time-phase markers, history-record
+    # words — into a CW*32-bit AFL-style bitmap; a set bit is a
+    # behavior the seed exhibited. Purely derived from dispatched
+    # values, so it never feeds back into the trajectory or the trace.
+    cov: jnp.ndarray  # (CW,) uint32 coverage bitmap words
+    cov_last: jnp.ndarray  # (N,) int32 last user kind per node (CW>0), else (0,)
 
     @property
     def sim_seconds(self):
@@ -756,11 +765,20 @@ class PlanRows:
     valid: jnp.ndarray  # (S, P) bool
 
 
+def _check_cov_words(cov_words: int) -> None:
+    if cov_words and (cov_words < 1 or cov_words & (cov_words - 1)):
+        raise ValueError(
+            f"cov_words={cov_words} must be 0 (off) or a power of two "
+            f"(the feature hash reduces by bitmask)"
+        )
+
+
 def make_init(
     wl: Workload,
     cfg: EngineConfig,
     time32: bool | None = None,
     plan_slots: int = 0,
+    cov_words: int = 0,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -772,6 +790,10 @@ def make_init(
     ``plan_slots=P`` reserves P pool rows per seed for a compiled fault
     plan (madsim_tpu.chaos): the returned ``init(seeds, plan)`` then
     requires a :class:`PlanRows` whose arrays carry the (S, P) events.
+
+    ``cov_words=CW`` sizes the per-seed coverage bitmap (CW*32 bits,
+    madsim_tpu.explore); must match the step builder's value. 0 (the
+    default) compiles recording away entirely.
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     p = plan_slots
@@ -781,6 +803,7 @@ def make_init(
             f"plus the {p} fault-plan rows"
         )
     _check_meta_ranges(wl)
+    _check_cov_words(cov_words)
     del k
     w = wl.payload_words
     h = wl.history.capacity if wl.history is not None else 0
@@ -839,6 +862,8 @@ def make_init(
             hist_drop=jnp.int32(0),
             hist_word=jnp.zeros((h, 5), jnp.int32),
             hist_t=jnp.zeros((h,), jnp.int64),
+            cov=jnp.zeros((cov_words,), jnp.uint32),
+            cov_last=jnp.zeros((n if cov_words else 0,), jnp.int32),
         )
 
     def init(seeds, plan: PlanRows | None = None) -> SimState:
@@ -891,6 +916,7 @@ def make_step(
     layout: str | None = None,
     time32: bool | None = None,
     dup_rows: bool = False,
+    cov_words: int = 0,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -928,6 +954,16 @@ def make_step(
     rows cost pool-placement work every step, so they are compiled only
     when a fault plan actually uses duplication; with the flag off (or
     ``dup`` never set) values are bit-identical to the plain step.
+
+    ``cov_words=CW`` compiles the coverage taps (madsim_tpu.explore):
+    each dispatched event folds behavior features into the seed's
+    CW*32-bit bitmap — (node, previous kind, kind) transition pairs for
+    user events, (kind, coarse time phase) markers for engine/chaos
+    events (so injected crash/partition phases are coverage), and the
+    (op, key, arg, ok) words of appended history records (term bumps
+    and leader changes become bits). Coverage is derived state only:
+    with CW=0 (default) the block compiles away and values are
+    bit-identical to the pre-coverage step.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -946,6 +982,7 @@ def make_step(
     volatile = wl.volatile_mask()
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
+    _check_cov_words(cov_words)
     if layout is None:
         layout = "scatter" if jax.default_backend() == "cpu" else "dense"
     if layout not in ("dense", "scatter"):
@@ -1529,6 +1566,97 @@ def make_step(
             hist_count, hist_drop = st.hist_count, st.hist_drop
             hist_word, hist_t = st.hist_word, st.hist_t
 
+        # ---- coverage taps (madsim_tpu.explore) ----
+        # derived state only: features of the event just dispatched are
+        # hashed into an AFL-style bitmap. Nothing here feeds back into
+        # the trajectory, the RNG, or the trace, so cov_words=0 (no
+        # arrays, no ops) and cov_words>0 produce identical traces.
+        if cov_words:
+            cb_mask = jnp.uint32(cov_words * 32 - 1)
+            cw_ids = jnp.arange(cov_words, dtype=jnp.uint32)
+
+            def _cov_mix(x):
+                # 32-bit finalizer (splitmix-style): pure uint32 ALU,
+                # bit-identical across backends like everything else
+                x = jnp.asarray(x).astype(jnp.uint32)
+                x = (x ^ (x >> jnp.uint32(16))) * jnp.uint32(0x7FEB352D)
+                x = (x ^ (x >> jnp.uint32(15))) * jnp.uint32(0x846CA68B)
+                return x ^ (x >> jnp.uint32(16))
+
+            def _cov_set(cov_acc, feat, on):
+                bit = _cov_mix(feat) & cb_mask
+                sel = cw_ids == (bit >> jnp.uint32(5))
+                m = jnp.uint32(1) << (bit & jnp.uint32(31))
+                return cov_acc | jnp.where(sel & on, m, jnp.uint32(0))
+
+            # per-node event-kind transition pair (prev -> kind at dst)
+            if dense:
+                prev_kind = jnp.sum(
+                    jnp.where(dst_oh, st.cov_last, 0)
+                ).astype(jnp.int32)
+            else:
+                prev_kind = jnp.where(in_range, st.cov_last[dst_c], 0)
+            f_user = (
+                kind.astype(jnp.uint32)
+                | (prev_kind.astype(jnp.uint32) << jnp.uint32(8))
+                | (jnp.maximum(dst, 0).astype(jnp.uint32) << jnp.uint32(16))
+            )
+            cov = _cov_set(st.cov, f_user, user_dispatch)
+            # coarse time phase (~134 ms buckets): behaviors that recur
+            # in NEW phases are new bits, which keeps long/late
+            # trajectories distinguishable from early ones
+            phase = jnp.minimum(now >> jnp.int64(27), 31).astype(jnp.uint32)
+            # engine/chaos kind x phase: crash/partition/heal phases of
+            # an injected plan are coverage features, so a mutated
+            # fault time that lands in a new phase is "interesting"
+            # even before the protocol reacts
+            f_chaos = (
+                kind.astype(jnp.uint32)
+                | (phase << jnp.uint32(8))
+                | jnp.uint32(1 << 24)
+            )
+            cov = _cov_set(cov, f_chaos, dispatch & is_engine)
+            # message edge (kind, src -> dst): which protocol messages
+            # flowed between which nodes — partitions and gray failures
+            # reshape exactly this
+            f_edge = (
+                kind.astype(jnp.uint32)
+                | (jnp.maximum(src, 0).astype(jnp.uint32) << jnp.uint32(8))
+                | (jnp.maximum(dst, 0).astype(jnp.uint32) << jnp.uint32(16))
+                | jnp.uint32(3 << 24)
+            )
+            cov = _cov_set(cov, f_edge, user_dispatch & is_msg)
+            # user kind x phase: WHEN the protocol did something, not
+            # just that it did — a second election at 500 ms is a
+            # different behavior than the first at 200 ms
+            f_when = (
+                kind.astype(jnp.uint32)
+                | (phase << jnp.uint32(8))
+                | jnp.uint32(4 << 24)
+            )
+            cov = _cov_set(cov, f_when, user_dispatch)
+            # appended history records: (op, key, arg, ok) words — term
+            # bumps, elected leaders, committed (index, value) pairs
+            for j in range(rr):
+                f_rec = (
+                    (uem.rec[j, 0].astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+                    ^ (uem.rec[j, 1].astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+                    ^ (uem.rec[j, 2].astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+                    ^ uem.rec[j, 3].astype(jnp.uint32)
+                    ^ jnp.uint32(2 << 24)
+                )
+                cov = _cov_set(cov, f_rec, user_dispatch & uem.rec_valid[j])
+            if dense:
+                cov_last = jnp.where(
+                    dst_oh & user_dispatch, kind, st.cov_last
+                ).astype(jnp.int32)
+            else:
+                cov_last = st.cov_last.at[
+                    jnp.where(in_range & user_dispatch, dst_c, jnp.int32(n))
+                ].set(kind, mode="drop")
+        else:
+            cov, cov_last = st.cov, st.cov_last
+
         # ---- trace + clock ----
         trace = jnp.where(
             dispatch,
@@ -1562,6 +1690,8 @@ def make_step(
             hist_drop=hist_drop,
             hist_word=hist_word,
             hist_t=hist_t,
+            cov=cov,
+            cov_last=cov_last,
         )
 
     return step
@@ -1574,6 +1704,7 @@ def make_run(
     layout: str | None = None,
     time32: bool | None = None,
     dup_rows: bool = False,
+    cov_words: int = 0,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -1589,7 +1720,7 @@ def make_run(
     check ``overflow == 0`` before trusting per-seed results (bench.py
     and engine.search do; direct callers are responsible themselves).
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -1608,6 +1739,7 @@ def make_run_while(
     layout: str | None = None,
     time32: bool | None = None,
     dup_rows: bool = False,
+    cov_words: int = 0,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -1623,7 +1755,7 @@ def make_run_while(
     silently continues — check ``overflow == 0`` before trusting
     per-seed results.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows))
+    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
 
     def run(state: SimState) -> SimState:
         def cond(carry):

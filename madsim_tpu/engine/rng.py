@@ -39,12 +39,14 @@ import jax.numpy as jnp
 __all__ = [
     "threefry2x32",
     "np_threefry2x32",
+    "np_threefry2x32v",
     "Draw",
     "PURPOSE_POLL_COST",
     "PURPOSE_LATENCY",
     "PURPOSE_LOSS",
     "PURPOSE_DUP",
     "PURPOSE_PLAN",
+    "PURPOSE_EXPLORE",
     "PURPOSE_USER",
 ]
 
@@ -85,6 +87,14 @@ PURPOSE_USER = 128  # + user purpose
 # (seed, plan-slot) pair is its own reproducible stream (the BatchRNG
 # varying-parameter-stream shape).
 PURPOSE_PLAN = 0x9E370000
+
+# Coverage-guided exploration (madsim_tpu.explore) derives fresh child
+# seeds and mutation draws from the campaign's ROOT seed with counter
+# x1 = PURPOSE_EXPLORE + batch-slot. Plan slots stay below 64k, so
+# PURPOSE_PLAN + slot < PURPOSE_EXPLORE — the two host-side streams can
+# never alias each other (and both sit far above every in-simulation
+# purpose).
+PURPOSE_EXPLORE = 0x9E380000
 
 
 def _rotl32(x, r: int):
@@ -138,6 +148,34 @@ def np_threefry2x32(k0, k1, x0, x1):
                 x1 = np.uint32(x1 ^ x0)
             x0 = np.uint32(x0 + ks[(chunk + 1) % 3])
             x1 = np.uint32(x1 + ks[(chunk + 2) % 3] + np.uint32(chunk + 1))
+    return x0, x1
+
+
+def np_threefry2x32v(k0, k1, x0, x1):
+    """Vectorized numpy form of :func:`np_threefry2x32` (same function,
+    ufunc ops instead of scalar casts so whole batches go at once) —
+    the generator behind host-side plan compilation (madsim_tpu.chaos)
+    and exploration seed/mutation derivation (madsim_tpu.explore)."""
+    k0 = np.asarray(k0, np.uint32)
+    k1 = np.asarray(k1, np.uint32)
+    x0 = np.asarray(x0, np.uint32)
+    x1 = np.asarray(x1, np.uint32)
+    with np.errstate(over="ignore"):
+        ks = (k0, k1, (k0 ^ k1 ^ _PARITY).astype(np.uint32))
+        x0 = (x0 + ks[0]).astype(np.uint32)
+        x1 = (x1 + ks[1]).astype(np.uint32)
+        for chunk in range(5):
+            rots = _ROTATIONS[:4] if chunk % 2 == 0 else _ROTATIONS[4:]
+            for r in rots:
+                x0 = (x0 + x1).astype(np.uint32)
+                x1 = ((x1 << np.uint32(r)) | (x1 >> np.uint32(32 - r))).astype(
+                    np.uint32
+                )
+                x1 = (x1 ^ x0).astype(np.uint32)
+            x0 = (x0 + ks[(chunk + 1) % 3]).astype(np.uint32)
+            x1 = (x1 + ks[(chunk + 2) % 3] + np.uint32(chunk + 1)).astype(
+                np.uint32
+            )
     return x0, x1
 
 

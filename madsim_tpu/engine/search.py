@@ -55,23 +55,26 @@ _RUN_CACHE: dict = {}
 
 
 def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
-                  compact: bool, plan_slots: int = 0, dup_rows: bool = False):
+                  compact: bool, plan_slots: int = 0, dup_rows: bool = False,
+                  cov_words: int = 0):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
-           dup_rows)
+           dup_rows, cov_words)
     if key not in _RUN_CACHE:
         if compact:
             run = make_run_compacted(
-                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows
+                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
+                cov_words=cov_words,
             )
         else:
             run = jax.jit(make_run_while(
-                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows
+                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
+                cov_words=cov_words,
             ))
         _RUN_CACHE[key] = (
-            make_init(wl, cfg, plan_slots=plan_slots),
+            make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words),
             run,
             wl,  # keep the workload alive so id() stays unique
         )
@@ -98,6 +101,12 @@ class SearchReport:
     # fault-plan hash when the sweep ran under a chaos plan: the repro
     # key is then (seed, config, plan) — all three printed in the banner
     plan_hash: str = ""
+    # per-seed coverage bitmaps, (S, cov_words) uint32 — None unless the
+    # sweep ran with cov_words > 0 (madsim_tpu.explore)
+    cov: np.ndarray | None = None
+    # (S,) int64 per-seed halt clock (0 while running) — the causal
+    # horizon explore's mutators use to avoid perturbing post-halt slots
+    halt_times: np.ndarray | None = None
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -167,6 +176,11 @@ def search_seeds(
     compact: bool = False,
     history_invariant: Callable | None = None,
     plan=None,
+    seeds: np.ndarray | None = None,
+    plan_rows=None,
+    plan_hash: str | None = None,
+    dup_rows: bool | None = None,
+    cov_words: int = 0,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -203,6 +217,17 @@ def search_seeds(
     hand-rolled per-model chaos. The plan hash joins the repro banner —
     ``(seed, config, plan)`` is then the complete repro key. Requires
     ``cfg.pool_size >= n_nodes + plan.slots``.
+
+    The coverage-guided exploration loop (``madsim_tpu.explore``) uses
+    three extensions: ``seeds`` replaces the contiguous
+    ``seed_base..+n_seeds`` range with an explicit seed array (mutated
+    corpora draw fresh threefry-derived seeds, not consecutive ints);
+    ``plan_rows`` injects PRE-COMPILED per-seed plan rows — every row
+    may carry a *different* mutated plan, which no single ``plan``
+    object can express (pass ``plan_hash`` to label the banner, and
+    ``dup_rows=True`` if any row uses duplication); ``cov_words=CW``
+    runs the engine's coverage taps and returns the per-seed bitmaps
+    as ``report.cov`` (S, CW).
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
@@ -211,14 +236,39 @@ def search_seeds(
         )
     if invariant is None and history_invariant is None:
         raise ValueError("need an invariant, a history_invariant, or both")
-    seeds = np.arange(seed_base, seed_base + n_seeds, dtype=np.uint64)
-    plan_slots = int(plan.slots) if plan is not None else 0
-    dup_rows = bool(plan.uses_dup()) if plan is not None else False
-    init, run, _ = _compiled_run(
-        wl, cfg, max_steps, layout, compact, plan_slots, dup_rows
-    )
+    if plan is not None and plan_rows is not None:
+        raise ValueError("pass plan OR plan_rows, not both")
+    if seeds is None:
+        seeds = np.arange(seed_base, seed_base + n_seeds, dtype=np.uint64)
+    else:
+        seeds = np.asarray(seeds, np.uint64)
+        if seeds.ndim != 1:
+            raise ValueError(f"seeds must be 1-D, got shape {seeds.shape}")
+        n_seeds = len(seeds)
     if plan is not None:
+        plan_slots = int(plan.slots)
+        if dup_rows is None:
+            dup_rows = bool(plan.uses_dup())
         rows = plan.compile_batch(seeds, wl=wl)
+        if plan_hash is None:
+            plan_hash = plan.hash()
+    elif plan_rows is not None:
+        rows = plan_rows
+        plan_slots = int(np.asarray(rows.time).shape[1])
+        if np.asarray(rows.time).shape[0] != n_seeds:
+            raise ValueError(
+                f"plan_rows carries {np.asarray(rows.time).shape[0]} rows "
+                f"for {n_seeds} seeds"
+            )
+        dup_rows = bool(dup_rows)
+    else:
+        rows = None
+        plan_slots = 0
+        dup_rows = bool(dup_rows)
+    init, run, _ = _compiled_run(
+        wl, cfg, max_steps, layout, compact, plan_slots, dup_rows, cov_words
+    )
+    if rows is not None:
         if _resolve_time32(wl, cfg, None):
             # the compiled rows land in the int32 offset representation:
             # a plan event past the horizon would silently wrap
@@ -294,5 +344,7 @@ def search_seeds(
         overflowed=overflowed,
         traces=view["trace"],
         steps=int(np.asarray(out.step).max()),
-        plan_hash=plan.hash() if plan is not None else "",
+        plan_hash=plan_hash or "",
+        cov=np.asarray(view["cov"]) if cov_words else None,
+        halt_times=np.asarray(view["halt_time"]),
     )

@@ -1,0 +1,47 @@
+"""madsim_tpu.explore — coverage-guided schedule exploration.
+
+MadSim finds rare interleavings by brute chaos: sweep enough random
+seeds and hope. The exploration subsystem upgrades the batched engine
+from that blind sweep into an AFL-style greybox fuzzer over
+distributed-protocol state space:
+
+* **on-device coverage** (engine/core.py ``cov_words``) — every seed
+  folds behavior features (per-node event-kind transitions, chaos kind
+  x time-phase markers, history-record words) into a per-seed bitmap;
+  only bitmaps and popcount deltas cross to the host, never raw traces;
+* **a corpus** of interesting ``(seed, LiteralPlan)`` entries — kept
+  iff they set new bits in the global coverage map (or violate);
+* **a mutation engine** (explore/mutate.py) — retime / retarget /
+  drop / add over the plan's slots, every draw threefry-keyed from the
+  campaign's root seed;
+* **the driver** (explore/driver.py) — each generation is ONE vmapped
+  batch through the engine's compiled-run cache; violations carry a
+  complete ``(root seed, generation, entry id)`` repro key and feed
+  ``chaos.shrink_plan`` directly.
+
+Evidence artifact: ``tools/explore_soak.py`` (EXPLORE_r08.txt) — at
+equal simulation budget the guided loop reaches more coverage and
+multiplies violation counts over the uniform nemesis sweep.
+"""
+
+from .coverage import admit, merge, popcount  # noqa: F401
+from .driver import (  # noqa: F401
+    CorpusEntry,
+    ExploreReport,
+    replay_entry,
+    run,
+)
+from .mutate import HostStream, PlanSpace, mutate_plan  # noqa: F401
+
+__all__ = [
+    "CorpusEntry",
+    "ExploreReport",
+    "HostStream",
+    "PlanSpace",
+    "admit",
+    "merge",
+    "mutate_plan",
+    "popcount",
+    "replay_entry",
+    "run",
+]

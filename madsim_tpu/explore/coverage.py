@@ -1,0 +1,65 @@
+"""Coverage accounting for the exploration loop.
+
+The engine's coverage taps (engine/core.py, ``cov_words``) hand back one
+AFL-style bitmap per seed: a set bit is a behavior feature the seed
+exhibited (a per-node event-kind transition, a chaos kind in a time
+phase, a history-record word). This module turns those per-seed bitmaps
+into the two quantities the corpus loop needs:
+
+* **admission** — for each entry of a generation, IN BATCH ORDER, how
+  many bits it sets that neither the global map nor any earlier entry of
+  the same generation set. Sequential semantics matter: two mutants that
+  discover the same new behavior must not both be admitted. The scan
+  runs on device (``lax.scan`` + popcount over uint32 words), so raw
+  trace data never crosses to the host — only the (B,) new-bit counts
+  and the merged (CW,) map do.
+* **merging / counting** — plain OR-folds and popcounts, used by the
+  equal-budget uniform-baseline comparison (tools/explore_soak.py) and
+  the sharded form in madsim_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["admit", "merge", "popcount"]
+
+
+def popcount(bitmap) -> int:
+    """Total set bits of a coverage bitmap (any shape of uint32 words)."""
+    words = np.ascontiguousarray(np.asarray(bitmap, np.uint32))
+    return int(np.unpackbits(words.view(np.uint8)).sum())
+
+
+def merge(bitmaps) -> np.ndarray:
+    """OR-fold (S, CW) per-seed bitmaps into one (CW,) global map."""
+    return np.bitwise_or.reduce(np.asarray(bitmaps, np.uint32), axis=0)
+
+
+@jax.jit
+def _admit_scan(global_map, cov_batch):
+    def body(carry, row):
+        fresh = jnp.sum(lax.population_count(row & ~carry)).astype(jnp.int32)
+        return carry | row, fresh
+
+    return lax.scan(body, global_map, cov_batch)
+
+
+def admit(cov_batch, global_map):
+    """Sequential-admission pass over one generation.
+
+    ``cov_batch`` is the (B, CW) uint32 bitmaps of the generation in
+    batch order; ``global_map`` the (CW,) map before this generation.
+    Returns ``(new_bits, merged)``: ``new_bits[j]`` counts bits entry j
+    set that neither the global map nor entries 0..j-1 set (the corpus
+    keeps entry j iff ``new_bits[j] > 0``), and ``merged`` is the
+    global map with the whole generation folded in.
+    """
+    merged, news = _admit_scan(
+        jnp.asarray(global_map, jnp.uint32), jnp.asarray(cov_batch, jnp.uint32)
+    )
+    return np.asarray(news), np.asarray(merged)

@@ -1,0 +1,397 @@
+"""The coverage-guided exploration loop (the AFL shape, batched).
+
+One campaign = ``generations`` batched sweeps of ``batch`` candidate
+``(seed, plan)`` pairs each:
+
+* **generation 0** is the uniform baseline: fresh threefry-derived
+  seeds, each running the plan space's FaultPlan exactly as
+  ``search_seeds(plan=...)`` would (optionally spiked with
+  ``seed_corpus`` literals — targeted hunt knowledge);
+* **every later generation** breeds candidates from the corpus:
+  parents are picked frontier-first (violating entries before clean
+  ones, newest first within each group), each child gets a mutated plan
+  (explore/mutate.py) plus either its parent's engine seed (tune the
+  fault alignment) or a fresh one, and the whole generation executes
+  as ONE vmapped batch through ``search_seeds``'s compiled-run cache —
+  same slot count every time, so the XLA program compiles once;
+* after each generation the on-device admission scan
+  (explore/coverage.py) scores every candidate by the bits it newly
+  set; entries with fresh coverage (or a violation) join the corpus.
+
+Everything — seeds, mutation draws, parent picks — derives from ONE
+root seed via counter-based threefry, so the entire campaign is
+replayable: same root, same corpus, same coverage map, same violations,
+across runs and across engine layouts. Each violation's
+``(root_seed, generation, entry id)`` is a complete repro key; the
+entry's stored ``(seed, LiteralPlan)`` replays to the identical trace
+hash (:func:`replay_entry`), and feeds ``chaos.shrink_plan`` directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..chaos.plan import (
+    FaultEvent,
+    FaultPlan,
+    LiteralPlan,
+    stack_plan_rows,
+)
+from ..engine.core import KIND_NOP
+from ..engine.rng import PURPOSE_EXPLORE, np_threefry2x32v
+from ..engine.search import SearchReport, search_seeds
+from .coverage import admit, popcount
+from .mutate import HostStream, PlanSpace, mutate_plan
+
+__all__ = ["CorpusEntry", "ExploreReport", "replay_entry", "run"]
+
+
+@dataclasses.dataclass
+class CorpusEntry:
+    """One interesting ``(seed, plan)`` pair.
+
+    ``(root seed, generation, id)`` identifies the entry within its
+    campaign; ``(seed, plan)`` + the sweep parameters replay its exact
+    trajectory (``trace`` is the hash the replay must reproduce)."""
+
+    id: int
+    generation: int
+    parent: int  # corpus id of the parent entry; -1 for generation 0
+    seed: int  # engine seed (threefry-derived from the root)
+    plan: LiteralPlan
+    trace: int  # uint64 trace hash of the run
+    cov: np.ndarray  # (CW,) uint32 coverage signature
+    new_bits: int  # bits this entry set first (admission score)
+    violating: bool
+    halt_t: int = 0  # halt clock ns (0 = ran to the step cap) — the
+    # causal horizon the mutators respect when breeding from this entry
+
+
+@dataclasses.dataclass
+class ExploreReport:
+    """Outcome of one exploration campaign."""
+
+    workload: str
+    config_hash: str
+    plan_hash: str  # the plan space (generation-0 FaultPlan) hash
+    root_seed: int
+    generations: int
+    batch: int
+    max_steps: int
+    cov_words: int
+    sims: int  # total simulations executed (the budget spent)
+    corpus: list  # admitted CorpusEntry list, admission order
+    violations: list  # violating CorpusEntry list (also in corpus)
+    cov_map: np.ndarray  # (CW,) uint32 final global coverage map
+    curve: list  # coverage bits after each generation
+    viol_curve: list  # cumulative violation count after each generation
+
+    @property
+    def coverage_bits(self) -> int:
+        return popcount(self.cov_map)
+
+    def banner(self, limit: int = 5) -> str:
+        lines = [
+            f"explore over {self.workload!r}: {self.sims} sims "
+            f"({self.generations} generations x {self.batch}), root_seed="
+            f"{self.root_seed} space={self.plan_hash} "
+            f"config_hash={self.config_hash}",
+            f"  coverage: {self.coverage_bits} bits "
+            f"({self.cov_words * 32} max), corpus {len(self.corpus)} "
+            f"entries, curve {self.curve}",
+            f"  violations: {len(self.violations)}",
+        ]
+        for e in self.violations[:limit]:
+            lines.append(
+                f"  violation g{e.generation} id{e.id}: seed {e.seed} "
+                f"plan_hash={e.plan.hash()} trace={e.trace:#x}"
+            )
+        if len(self.violations) > limit:
+            lines.append(f"  ... and {len(self.violations) - limit} more")
+        return "\n".join(lines)
+
+
+def _derive_keys(root_seed: int, generation: int, batch: int):
+    """Child threefry keys for one generation: key = threefry(root,
+    (generation, PURPOSE_EXPLORE + batch-slot)) — the (corpus-id,
+    generation, slot) derivation of the design, order-independent
+    coordinates like every other stream in the repo."""
+    root = np.uint64(root_seed)
+    k0 = np.uint32(root & np.uint64(0xFFFFFFFF))
+    k1 = np.uint32(root >> np.uint64(32))
+    j = np.arange(batch, dtype=np.uint32)
+    a, b = np_threefry2x32v(
+        k0, k1, np.uint32(generation & 0xFFFFFFFF),
+        np.uint32(PURPOSE_EXPLORE) + j,
+    )
+    return a, b
+
+
+def _child_seeds(k0s, k1s) -> np.ndarray:
+    return k0s.astype(np.uint64) | (k1s.astype(np.uint64) << np.uint64(32))
+
+
+def _literal_from_rows(rows, j: int, name: str) -> LiteralPlan:
+    """Row ``j`` of a compiled PlanRows batch as an exactly-replaying
+    LiteralPlan (all slots kept, invalid ones disabled — the
+    FaultPlan.literalize layout rule)."""
+    time = np.asarray(rows.time)
+    kind = np.asarray(rows.kind)
+    args = np.asarray(rows.args)
+    valid = np.asarray(rows.valid)
+    events = tuple(
+        FaultEvent(
+            t=int(time[j, p]), kind=int(kind[j, p]),
+            a0=int(args[j, p, 0]), a1=int(args[j, p, 1]),
+        )
+        for p in range(time.shape[1])
+    )
+    return LiteralPlan(
+        events=events, enabled=tuple(bool(x) for x in valid[j]), name=name
+    )
+
+
+def _pad_literal(lp: LiteralPlan, slots: int) -> LiteralPlan:
+    if lp.slots > slots:
+        raise ValueError(
+            f"seed-corpus plan {lp.name!r} has {lp.slots} slots; the plan "
+            f"space has only {slots}"
+        )
+    pad = slots - lp.slots
+    return LiteralPlan(
+        events=tuple(lp.events) + tuple(
+            FaultEvent(t=0, kind=KIND_NOP) for _ in range(pad)
+        ),
+        enabled=tuple(lp._mask()) + (False,) * pad,
+        name=lp.name,
+    )
+
+
+def replay_entry(
+    wl,
+    cfg,
+    entry: CorpusEntry,
+    *,
+    invariant=None,
+    history_invariant=None,
+    max_steps: int = 1000,
+    require_halt: bool = False,
+    layout: str | None = None,
+    compact: bool = False,
+    cov_words: int = 0,
+    dup_rows: bool | None = None,
+) -> SearchReport:
+    """Re-execute one corpus entry's exact ``(seed, plan)`` pair.
+
+    With the campaign's sweep parameters (``max_steps`` etc.) the
+    returned report's trace equals ``entry.trace`` and its verdict
+    reproduces the stored violation — the per-entry determinism
+    guarantee tests and the soak assert. ``dup_rows`` defaults to what
+    the entry's plan needs (the shrink_plan rule) — pass it explicitly
+    only to replay under a differently compiled step on purpose.
+    """
+    if dup_rows is None:
+        dup_rows = bool(entry.plan.uses_dup())
+    if invariant is None and history_invariant is None:
+        invariant = lambda view: np.ones(  # noqa: E731 — replay-only
+            np.asarray(view["halted"]).shape[0], bool
+        )
+    return search_seeds(
+        wl, cfg, invariant,
+        seeds=np.asarray([entry.seed], np.uint64),
+        max_steps=max_steps, require_halt=require_halt, layout=layout,
+        compact=compact, history_invariant=history_invariant,
+        plan_rows=stack_plan_rows([entry.plan]),
+        plan_hash=entry.plan.hash(), dup_rows=dup_rows,
+        cov_words=cov_words,
+    )
+
+
+def run(
+    wl,
+    cfg,
+    space,
+    *,
+    invariant=None,
+    history_invariant=None,
+    generations: int = 8,
+    batch: int = 256,
+    root_seed: int = 0,
+    max_steps: int = 1000,
+    cov_words: int = 32,
+    layout: str | None = None,
+    compact: bool = False,
+    require_halt: bool = False,
+    seed_corpus=(),
+    select_top: int = 32,
+    max_corpus: int = 4096,
+    max_ops: int = 3,
+    inherit_seed_p: float = 0.75,
+    log=None,
+) -> ExploreReport:
+    """Run one coverage-guided exploration campaign.
+
+    ``space`` is a :class:`PlanSpace` (or a bare :class:`FaultPlan`,
+    wrapped automatically). ``invariant`` / ``history_invariant`` follow
+    the ``search_seeds`` contract; ``require_halt`` defaults to False —
+    a safety hunt judges the recorded history, not liveness (the
+    ``shrink_plan`` rule). ``seed_corpus`` literals (padded to the
+    space's slot count) replace the first generation-0 rows: targeted
+    hunt knowledge enters the loop as corpus seeds, the greybox-fuzzing
+    idiom. ``inherit_seed_p`` is the fraction of children that keep
+    their parent's engine seed (tune the fault alignment against a
+    fixed protocol trajectory) instead of drawing a fresh one (explore
+    seed space). ``log`` (callable, e.g. ``print``) gets one line per
+    generation.
+    """
+    if isinstance(space, FaultPlan):
+        space = PlanSpace(space)
+    if cov_words < 1:
+        raise ValueError("exploration needs cov_words >= 1 (the guidance)")
+    if generations < 1 or batch < 1:
+        raise ValueError("need generations >= 1 and batch >= 1")
+    if len(seed_corpus) > batch:
+        raise ValueError(
+            f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
+        )
+    dup = space.uses_dup()
+    global_map = np.zeros((cov_words,), np.uint32)
+    corpus: list[CorpusEntry] = []
+    by_id: dict[int, CorpusEntry] = {}
+    violations: list[CorpusEntry] = []
+    seen_viol: set = set()  # (seed, trace) — a violation is counted once
+    curve: list[int] = []
+    viol_curve: list[int] = []
+    next_id = 0
+    sims = 0
+
+    for g in range(generations):
+        k0s, k1s = _derive_keys(root_seed, g, batch)
+        seeds = _child_seeds(k0s, k1s)
+        overrides: dict[int, LiteralPlan] = {}
+        if g == 0 or not corpus:
+            # uniform generation: the plan space's own per-seed draws
+            # (identical to what search_seeds(plan=space.plan) runs)
+            rows = space.plan.compile_batch(seeds, wl=wl)
+            plans = None
+            parents = [-1] * batch
+            if g == 0:
+                for j, lp in enumerate(seed_corpus):
+                    padded = _pad_literal(lp, space.slots)
+                    overrides[j] = padded
+                    time = np.asarray(rows.time)
+                    time[j] = [e.t for e in padded.events]
+                    np.asarray(rows.kind)[j] = [e.kind for e in padded.events]
+                    np.asarray(rows.args)[j] = [
+                        (e.a0, e.a1) for e in padded.events
+                    ]
+                    np.asarray(rows.valid)[j] = padded._mask()
+        else:
+            # parent pool: violating entries first, NEWEST first — the
+            # frontier keeps drifting into fresh trajectory
+            # neighborhoods instead of re-mining generation 0 (whose
+            # traces the dedup has already seen); the newest
+            # non-violating entries fill the remainder (recency over
+            # new-bit count won the kvchaos equal-budget measurement)
+            order = [
+                e.id
+                for e in sorted(
+                    corpus,
+                    key=lambda e: (not e.violating, -e.id),
+                )[:select_top]
+            ]
+            plans = []
+            parents = []
+            seeds = seeds.copy()
+            for j in range(batch):
+                st = HostStream(int(k0s[j]), int(k1s[j]), PURPOSE_EXPLORE)
+                pid = order[st.bits() % len(order)]
+                parents.append(pid)
+                # inheriting children keep the parent's engine seed:
+                # protocol timing stays fixed while the plan mutates,
+                # so a near-miss fault alignment can be tuned instead
+                # of re-rolled (the rest re-key both, keeping
+                # seed-space exploration alive)
+                if st.bits() < int(inherit_seed_p * (1 << 32)):
+                    seeds[j] = np.uint64(by_id[pid].seed)
+                parent = by_id[pid]
+                plans.append(
+                    mutate_plan(
+                        parent.plan, space, st, max_ops=max_ops,
+                        name=f"g{g}p{pid}",
+                        horizon=parent.halt_t if parent.halt_t > 0 else None,
+                    )
+                )
+            rows = stack_plan_rows(plans)
+
+        report = search_seeds(
+            wl, cfg, invariant,
+            seeds=seeds, max_steps=max_steps, require_halt=require_halt,
+            layout=layout, compact=compact,
+            history_invariant=history_invariant,
+            plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
+            cov_words=cov_words,
+        )
+        sims += batch
+        failing = ~report.ok & ~report.overflowed
+        # overflowed seeds are quarantined from guidance too: their
+        # trajectories dropped events, so their bitmaps are artifacts
+        cov_in = np.where(report.overflowed[:, None], np.uint32(0), report.cov)
+        new_bits, global_map = admit(cov_in, global_map)
+        admitted = 0
+        for j in range(batch):
+            key = (int(seeds[j]), int(report.traces[j]))
+            fresh_viol = bool(failing[j]) and key not in seen_viol
+            if not (new_bits[j] > 0 or fresh_viol):
+                continue
+            if plans is not None:
+                plan = plans[j]
+            else:
+                plan = overrides.get(j) or _literal_from_rows(
+                    rows, j, name=f"{space.plan.name}@{int(seeds[j])}"
+                )
+            entry = CorpusEntry(
+                id=next_id, generation=g, parent=parents[j],
+                seed=int(seeds[j]), plan=plan,
+                trace=int(report.traces[j]), cov=report.cov[j].copy(),
+                new_bits=int(new_bits[j]), violating=bool(failing[j]),
+                halt_t=int(report.halt_times[j]),
+            )
+            next_id += 1
+            if fresh_viol:
+                # a violation is counted once per distinct (seed, trace)
+                # trajectory — an inherited-seed child replaying its
+                # parent's exact run is a duplicate, not a find
+                seen_viol.add(key)
+                violations.append(entry)
+            if len(corpus) < max_corpus:
+                corpus.append(entry)
+                by_id[entry.id] = entry
+                admitted += 1
+        curve.append(popcount(global_map))
+        viol_curve.append(len(violations))
+        if log is not None:
+            log(
+                f"explore g{g}: {curve[-1]} coverage bits (+{admitted} "
+                f"corpus entries, corpus {len(corpus)}), "
+                f"{len(violations)} violations"
+            )
+
+    return ExploreReport(
+        workload=wl.name,
+        config_hash=cfg.hash(),
+        plan_hash=space.hash(),
+        root_seed=int(root_seed),
+        generations=generations,
+        batch=batch,
+        max_steps=max_steps,
+        cov_words=cov_words,
+        sims=sims,
+        corpus=corpus,
+        violations=violations,
+        cov_map=global_map,
+        curve=curve,
+        viol_curve=viol_curve,
+    )

@@ -48,7 +48,17 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..engine import KIND_KILL, KIND_RESTART, Workload, user_kind
+from ..check.history import OP_USER
+from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
+
+# history op kind (record=True): a decide event per transaction — the
+# coordinator records its decision when votes resolve, and every
+# participant records the decision value it adopts (first adoption per
+# incarnation). check.election_safety(h, elect_op=OP_DECIDE) over
+# key=txn is then 2PC atomicity as a HISTORY property: no transaction
+# is ever decided/applied with two different outcomes anywhere in the
+# cluster, including decisions later overwritten by recovery traffic.
+OP_DECIDE = OP_USER
 
 COORD = 0
 
@@ -77,10 +87,18 @@ def make_twophase(
     chaos: bool = True,
     revive_min_ns: int = 80_000_000,
     revive_max_ns: int = 400_000_000,
+    record: bool = False,
 ) -> Workload:
     """``no_pct``: percent chance a participant votes NO per transaction.
     ``revive_min_ns`` must exceed the engine config's ``lat_max_ns`` for
-    the crash-recovery guarantee (module docstring)."""
+    the crash-recovery guarantee (module docstring).
+
+    ``record=True`` turns on operation-history recording
+    (madsim_tpu.check): the coordinator records one ``OP_DECIDE`` event
+    (key = txn, arg = commit/abort) when votes resolve, and every
+    participant records the decision it adopts, so
+    ``check.election_safety(h, elect_op=OP_DECIDE)`` asserts atomicity
+    over the whole run — the nemesis-soak oracle for this family."""
     n = 1 + n_parts
     parts = list(range(1, n))
     full_mask = (1 << n_parts) - 1
@@ -160,6 +178,11 @@ def make_twophase(
         _bcast_decision(
             eb, txn, (phase == 1).astype(jnp.int32), decide, jnp.int32(0)
         )
+        if record:
+            eb.record(
+                OP_DECIDE, key=txn, arg=(phase == 1).astype(jnp.int32),
+                when=decide,
+            )
         # no retx arm here: the per-transaction chain armed at prepare
         # time keeps firing while this txn is current and re-sends
         # whichever phase's messages are missing
@@ -178,6 +201,8 @@ def make_twophase(
         )
         eb = ctx.emits()
         eb.send(COORD, user_kind(_H_ACK), (txn, ctx.node))
+        if record:
+            eb.record(OP_DECIDE, key=txn, arg=commit, when=fresh)
         return new, eb.build()
 
     def on_ack(ctx):
@@ -260,7 +285,7 @@ def make_twophase(
         return ctx.state, eb.build()
 
     return Workload(
-        name="twophase",
+        name="twophase-record" if record else "twophase",
         handler_names=("init", "prepare", "vote", "decision", "ack", "retx", "hello", "hretx", "resync"),
         n_nodes=n,
         state_width=6,
@@ -275,4 +300,14 @@ def make_twophase(
         delay_bound_ns=max(retx_ns, 250_000_000 + revive_max_ns),
         # on_decision reads args[2]
         args_words=3,
+        # capacity: one coordinator decide + one adoption per
+        # participant per txn, plus re-adoptions after crash-restarts
+        # (a reborn participant's wiped state re-records the current
+        # txn once per retransmitted decision heard first). Overflow is
+        # loud (hist_drop) and search_seeds quarantines it.
+        history=(
+            HistorySpec(capacity=txns * (1 + n_parts) + 16, max_records=1)
+            if record
+            else None
+        ),
     )

@@ -43,6 +43,7 @@ _SM_NOCHECK = (
 
 __all__ = [
     "make_mesh",
+    "merge_coverage",
     "seed_sharding",
     "shard_state",
     "shard_over_seeds",
@@ -93,6 +94,45 @@ def shard_over_seeds(fn, mesh: Mesh):
     # a single sharding is a valid pytree prefix: it broadcasts to every
     # leaf of the SimState, all of which lead with the seed axis
     return jax.jit(fn, in_shardings=sh, out_shardings=sh)
+
+
+def merge_coverage(bitmaps, mesh: Mesh | None = None) -> np.ndarray:
+    """OR-fold per-seed coverage bitmaps (S, CW) into one (CW,) map.
+
+    With a ``mesh``, each device OR-folds its local seed shard
+    (``shard_map``, no cross-device traffic — XLA's collective reducers
+    don't implement bitwise OR, so the final fold of the D per-device
+    rows happens on the host, D*CW words of transfer). The sharded
+    coverage merge of a multi-chip exploration sweep
+    (madsim_tpu.explore): a 65k-seed generation's bitmaps reduce on the
+    mesh and only device-count rows reach the host. Without a mesh, the
+    same reduction runs on the default device. ``S`` must divide over
+    the mesh's device count.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    bm = jnp.asarray(bitmaps, jnp.uint32)
+    if bm.ndim != 2:
+        raise ValueError(f"bitmaps must be (S, CW), got shape {bm.shape}")
+
+    def fold(b):
+        return lax.reduce(b, jnp.uint32(0), lax.bitwise_or, (0,))
+
+    if mesh is None:
+        return np.asarray(jax.jit(fold)(bm))
+    n_dev = mesh.devices.size
+    if bm.shape[0] % n_dev:
+        raise ValueError(
+            f"{bm.shape[0]} bitmap rows do not split over {n_dev} devices"
+        )
+    spec = P(mesh.axis_names)
+    local = lambda b: fold(b)[None, :]  # noqa: E731 — (1, CW) per device
+    per_dev = jax.jit(
+        _shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+                   **_SM_NOCHECK)
+    )(bm)
+    return np.bitwise_or.reduce(np.asarray(per_dev, np.uint32), axis=0)
 
 
 def shard_run_compacted(

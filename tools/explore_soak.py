@@ -1,0 +1,278 @@
+"""Explore soak: coverage-guided search vs uniform chaos, plus the
+targeted diskless-raftlog hunt. The EXPLORE evidence artifact.
+
+Four certificates:
+
+1. **Guided beats uniform at equal budget** — on the kvchaos
+   ``bug=True`` lost-write mutant, the same simulation budget is spent
+   twice: once as a uniform nemesis sweep (``search_seeds(plan=...)``,
+   the PR-2 shape) and once as a coverage-guided campaign
+   (``explore.run``). The campaign must reach STRICTLY more coverage
+   bits and at least 2x the distinct violation count. The per-
+   generation coverage/violation curves are printed — the growth curve
+   is the artifact's centerpiece.
+2. **Campaign determinism** — the same root seed re-runs to an
+   identical corpus, coverage map and violation set; a violating
+   entry replays to its recorded trace hash and its stored plan
+   ddmin-shrinks + replays exactly (the full explore -> chaos.shrink
+   pipeline on one find).
+3. **The diskless-raftlog hunt** — ROADMAP's open target: diskless
+   raftlog (durable=False) can lose a committed value when BOTH
+   fresh-log voters are wiped while the up-to-date holders are
+   partitioned away (the reason raft's Figure 2 marks term/votedFor/
+   log persistent); 8192 uniform nemesis schedules never triggered it.
+   The hunt runs a targeted plan space (two-crash storm + flapping
+   partition) under the guided loop; electoral double-votes (wiped
+   votedFor) count as the same diskless-persistence bug class. If a
+   committed-value loss or double-vote is found it is shrunk to a
+   minimal replayable plan; otherwise the coverage evidence documents
+   the negative result (exit stays 0 — the certificate is the
+   INSTRUMENTED hunt, the find is the prize).
+4. **Shrink integration** — the first hunt violation (if any) feeds
+   ``chaos.shrink_plan`` and the shrunk plan replays to the identical
+   violation + trace.
+
+Usage: python tools/explore_soak.py [budget] > EXPLORE_r08.txt
+Exit 0 iff certificates 1-2 (and 4, when a find exists) hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore  # noqa: E402
+from madsim_tpu.chaos import (  # noqa: E402
+    CrashStorm,
+    FaultPlan,
+    FlappingPartition,
+    shrink_plan,
+)
+from madsim_tpu.check import (  # noqa: E402
+    election_safety,
+    read_your_writes,
+    stale_reads,
+)
+from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
+from madsim_tpu.models import make_kvchaos, make_raftlog  # noqa: E402
+from madsim_tpu.models.raftlog import OP_COMMIT, OP_ELECT  # noqa: E402
+
+W = 10  # kvchaos writes (the nemesis-soak shape)
+KV_STEPS = 4000
+CW = 64  # coverage words (2048 bits)
+
+KV_PLAN = FaultPlan((
+    CrashStorm(
+        targets=(1, 2, 3, 4), n=2,
+        t_min_ns=20_000_000, t_max_ns=400_000_000,
+        down_min_ns=50_000_000, down_max_ns=250_000_000,
+    ),
+), name="kv-nemesis")
+
+RL_NODES = (0, 1, 2, 3, 4)
+HUNT_PLAN = FaultPlan((
+    CrashStorm(
+        targets=RL_NODES, n=2,
+        t_min_ns=150_000_000, t_max_ns=500_000_000,
+        down_min_ns=100_000_000, down_max_ns=400_000_000,
+    ),
+    FlappingPartition(
+        targets=RL_NODES, n_cycles=2,
+        t_min_ns=50_000_000, t_max_ns=400_000_000,
+        dur_min_ns=100_000_000, dur_max_ns=300_000_000,
+        up_min_ns=20_000_000, up_max_ns=200_000_000,
+    ),
+), name="raftlog-hunt")
+HUNT_STEPS = 6000
+
+
+def kv_hinv(box):
+    def inv(h):
+        box["ok"] = stale_reads(h) & read_your_writes(h)
+        return box["ok"]
+
+    return inv
+
+
+def main() -> None:
+    budget = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    gens = 8
+    batch = max(budget // gens, 1)
+    # equal budget is the certificate's whole point: both sides run
+    # EXACTLY gens * batch sims, whatever was asked for
+    budget = gens * batch
+    failures = []
+    t_all = time.monotonic()
+    print(f"# explore soak: budget {budget} sims/side, "
+          f"platform={jax.devices()[0].platform}")
+    print(f"# kv plan {KV_PLAN.hash()} | hunt plan {HUNT_PLAN.hash()} "
+          f"({HUNT_PLAN.slots} slots)")
+
+    # ---- certificate 1: guided vs uniform at equal budget ----
+    wl_bug = make_kvchaos(writes=W, record=True, bug=True, chaos=False)
+    kv_cfg = EngineConfig(pool_size=192, loss_p=0.05)
+    t0 = time.monotonic()
+    box = {}
+    rep_u = search_seeds(
+        wl_bug, kv_cfg, None, n_seeds=budget, max_steps=KV_STEPS,
+        history_invariant=kv_hinv(box), plan=KV_PLAN, cov_words=CW,
+    )
+    u_viol = int((~box["ok"] & ~rep_u.overflowed).sum())
+    u_bits = explore.popcount(
+        explore.merge(np.where(rep_u.overflowed[:, None], 0, rep_u.cov))
+    )
+    print(f"uniform sweep:    {u_viol} violations, {u_bits} coverage bits "
+          f"/ {budget} sims ({time.monotonic() - t0:.1f}s)")
+
+    t0 = time.monotonic()
+    rep_e = explore.run(
+        wl_bug, kv_cfg, KV_PLAN, history_invariant=kv_hinv({}),
+        generations=gens, batch=batch, root_seed=7, max_steps=KV_STEPS,
+        cov_words=CW, max_ops=1, inherit_seed_p=0.9,
+    )
+    print(f"guided campaign:  {len(rep_e.violations)} violations, "
+          f"{rep_e.coverage_bits} coverage bits / {rep_e.sims} sims "
+          f"({time.monotonic() - t0:.1f}s)")
+    print(f"  coverage curve:  {rep_e.curve}")
+    print(f"  violation curve: {rep_e.viol_curve}")
+    ratio = len(rep_e.violations) / max(u_viol, 1)
+    print(f"  guided/uniform: {ratio:.2f}x violations, "
+          f"+{rep_e.coverage_bits - u_bits} coverage bits")
+    if rep_e.coverage_bits <= u_bits:
+        failures.append("guided-not-more-coverage")
+    if len(rep_e.violations) < 2 * u_viol:
+        failures.append("guided-below-2x-violations")
+
+    # ---- certificate 2: campaign determinism + replay + shrink ----
+    t0 = time.monotonic()
+    d_kw = dict(
+        history_invariant=kv_hinv({}), generations=3, batch=64,
+        root_seed=7, max_steps=KV_STEPS, cov_words=CW, max_ops=1,
+        inherit_seed_p=0.9,
+    )
+    da = explore.run(wl_bug, kv_cfg, KV_PLAN, **d_kw)
+    db = explore.run(wl_bug, kv_cfg, KV_PLAN, **d_kw)
+    fp = lambda r: (  # noqa: E731
+        [(e.id, e.seed, e.plan.hash(), e.trace) for e in r.corpus],
+        r.cov_map.tolist(), [(e.seed, e.trace) for e in r.violations],
+    )
+    same = fp(da) == fp(db)
+    replay_ok = shrink_ok = True
+    if da.violations:
+        e = da.violations[0]
+        box = {}
+        r = explore.replay_entry(
+            wl_bug, kv_cfg, e, history_invariant=kv_hinv(box),
+            max_steps=KV_STEPS,
+        )
+        replay_ok = int(r.traces[0]) == e.trace and not bool(box["ok"][0])
+        res = shrink_plan(
+            wl_bug, kv_cfg, e.seed, e.plan,
+            history_invariant=kv_hinv({}), max_steps=KV_STEPS,
+        )
+        rs = explore.replay_entry(
+            wl_bug, kv_cfg,
+            explore.CorpusEntry(
+                id=-1, generation=-1, parent=-1, seed=e.seed,
+                plan=res.plan, trace=res.trace, cov=e.cov, new_bits=0,
+                violating=True,
+            ),
+            history_invariant=kv_hinv({}), max_steps=KV_STEPS,
+        )
+        shrink_ok = int(rs.traces[0]) == res.trace
+        print(f"determinism: identical={same}; violation g{e.generation} "
+              f"id{e.id} replay={replay_ok}; shrink "
+              f"{res.original_events} -> {len(res.events)} events, "
+              f"shrunk replay={shrink_ok} ({time.monotonic() - t0:.1f}s)")
+    else:
+        print(f"determinism: identical={same}; no violation in the small "
+              f"campaign (replay/shrink not exercised) "
+              f"({time.monotonic() - t0:.1f}s)")
+    if not same:
+        failures.append("campaign-not-deterministic")
+    if not replay_ok:
+        failures.append("violation-replay-diverged")
+    if not shrink_ok:
+        failures.append("shrunk-replay-diverged")
+
+    # ---- certificates 3+4: the diskless-raftlog hunt ----
+    wl_rl = make_raftlog(record=True, chaos=False, durable=False)
+    rl_cfg = EngineConfig(
+        pool_size=128, loss_p=0.02, clog_backoff_max_ns=2_000_000_000
+    )
+    rl_box = {}
+
+    def rl_inv(h):
+        commit_ok = election_safety(h, elect_op=OP_COMMIT)
+        elect_ok = election_safety(h, elect_op=OP_ELECT)
+        rl_box["commit"] = commit_ok
+        rl_box["elect"] = elect_ok
+        return commit_ok & elect_ok
+
+    t0 = time.monotonic()
+    hunt = explore.run(
+        wl_rl, rl_cfg, HUNT_PLAN, history_invariant=rl_inv,
+        generations=gens, batch=batch, root_seed=2024,
+        max_steps=HUNT_STEPS, cov_words=CW, select_top=24, max_ops=2,
+        inherit_seed_p=0.85, require_halt=False,
+    )
+    print(f"raftlog hunt: {len(hunt.violations)} violations, "
+          f"{hunt.coverage_bits} coverage bits / {hunt.sims} sims "
+          f"({time.monotonic() - t0:.1f}s)")
+    print(f"  coverage curve:  {hunt.curve}")
+    print(f"  violation curve: {hunt.viol_curve}")
+    if hunt.violations:
+        e = hunt.violations[0]
+        rl_box.clear()
+        r = explore.replay_entry(
+            wl_rl, rl_cfg, e, history_invariant=rl_inv,
+            max_steps=HUNT_STEPS,
+        )
+        kind = ("committed-value-loss"
+                if not bool(rl_box["commit"][0]) else "double-vote")
+        hr_ok = int(r.traces[0]) == e.trace
+        print(f"  FOUND [{kind}]: root={hunt.root_seed} g{e.generation} "
+              f"id{e.id} seed={e.seed} plan={e.plan.hash()} "
+              f"trace={e.trace:#x} replay={hr_ok}")
+        t0 = time.monotonic()
+        res = shrink_plan(
+            wl_rl, rl_cfg, e.seed, e.plan, history_invariant=rl_inv,
+            max_steps=HUNT_STEPS,
+        )
+        print(res.banner())
+        rs = search_seeds(
+            wl_rl, rl_cfg, None, seeds=np.asarray([e.seed], np.uint64),
+            max_steps=HUNT_STEPS, history_invariant=rl_inv,
+            plan=res.plan, require_halt=False,
+        )
+        hs_ok = int(rs.traces[0]) == res.trace and not bool(rs.ok[0])
+        print(f"  shrink: {res.original_events} -> {len(res.events)} "
+              f"events, shrunk replay identical violation + trace: "
+              f"{hs_ok} ({time.monotonic() - t0:.1f}s)")
+        if not hr_ok:
+            failures.append("hunt-replay-diverged")
+        if not hs_ok:
+            failures.append("hunt-shrunk-replay-diverged")
+    else:
+        print("  NEGATIVE: no diskless committed-write loss or double-vote "
+              "within this budget; the coverage curve above documents the "
+              "explored behavior space (raise the budget to hunt deeper)")
+
+    verdict = "PASS" if not failures else f"FAIL ({', '.join(failures)})"
+    print(f"# verdict: {verdict} — coverage-guided exploration beats "
+          f"uniform chaos at equal budget and every find replays from "
+          f"its (root seed, generation, id) key")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,23 @@ Four certificates, written as the NEMESIS evidence artifact:
    this certificate exposed a commit-record artifact of the win-time
    re-stamp that looked exactly like lost data (see the OP_COMMIT note
    in models/raftlog.py).
+5. **raft election under nemesis** — the election-only model under a
+   PAUSE storm + gray failure: pauses hold a node's events without
+   wiping its votedFor, so election safety must hold exactly. (Kill
+   storms on this diskless model CAN legitimately double-vote — that
+   hunt belongs to tools/explore_soak.py, not to a clean certificate.)
+6. **paxos under nemesis** — single-decree paxos, built-in chaos off,
+   proposer crash storm + cluster-wide gray failure: agreement over
+   recorded OP_DECIDE events holds on every seed.
+7. **twophase under nemesis** — 2PC (built-in chaos off, so the
+   coordinator's loss-free RESYNC hook is absent) under a participant
+   crash + message-duplication plan: ATOMICITY (OP_DECIDE agreement)
+   holds on every seed. Liveness is NOT asserted — without the RESYNC
+   hook a crash-after-ack can legitimately stall a run (the module
+   docstring's documented race), so unhalted seeds are reported, not
+   failed.
 
-Usage: python tools/nemesis_soak.py [n_seeds] > NEMESIS_r07.txt
+Usage: python tools/nemesis_soak.py [n_seeds] > NEMESIS_r08.txt
 Exit 0 iff all certificates hold.
 """
 
@@ -40,8 +55,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from madsim_tpu.chaos import (  # noqa: E402
     CrashStorm,
+    Duplicate,
     FaultPlan,
     GrayFailure,
+    PauseStorm,
     shrink_plan,
 )
 from madsim_tpu.check import (  # noqa: E402
@@ -50,9 +67,18 @@ from madsim_tpu.check import (  # noqa: E402
     stale_reads,
 )
 from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
-from madsim_tpu.models import make_kvchaos, make_raftlog  # noqa: E402
+from madsim_tpu.models import (  # noqa: E402
+    make_kvchaos,
+    make_paxos,
+    make_raft,
+    make_raftlog,
+    make_twophase,
+)
+from madsim_tpu.models.paxos import OP_DECIDE as PX_OP_DECIDE  # noqa: E402
+from madsim_tpu.models.raft import OP_ELECT as R_OP_ELECT  # noqa: E402
 from madsim_tpu.models.raftlog import OP_COMMIT  # noqa: E402
 from madsim_tpu.models.raftlog import OP_ELECT as RL_OP_ELECT  # noqa: E402
+from madsim_tpu.models.twophase import OP_DECIDE as TP_OP_DECIDE  # noqa: E402
 
 W = 10  # kvchaos writes (the check-soak shape)
 STEPS = 4000
@@ -78,6 +104,52 @@ RAFT_PLAN = FaultPlan((
         mult_min=4, mult_max=16,
     ),
 ), name="raft-nemesis")
+
+# election-only raft is diskless by construction, so its clean
+# certificate runs PAUSES (state survives) instead of kills
+RAFT_EL_PLAN = FaultPlan((
+    PauseStorm(
+        targets=(0, 1, 2, 3, 4), n=2,
+        t_min_ns=20_000_000, t_max_ns=400_000_000,
+        down_min_ns=50_000_000, down_max_ns=300_000_000,
+    ),
+    GrayFailure(
+        targets=(0, 1, 2, 3, 4), n_links=2,
+        t_min_ns=20_000_000, t_max_ns=400_000_000,
+        dur_min_ns=50_000_000, dur_max_ns=300_000_000,
+        mult_min=4, mult_max=16,
+    ),
+), name="raft-election-nemesis")
+
+# paxos: crash storms hit PROPOSERS only (nodes A..A+P-1 = 5..7 at the
+# default shape) — diskless acceptors are allowed to lose promises, so
+# killing them is not a clean-model certificate
+PAXOS_PLAN = FaultPlan((
+    CrashStorm(
+        targets=(5, 6, 7), n=2,
+        t_min_ns=30_000_000, t_max_ns=200_000_000,
+        down_min_ns=80_000_000, down_max_ns=300_000_000,
+    ),
+    GrayFailure(
+        targets=(0, 1, 2, 3, 4, 5, 6, 7), n_links=2,
+        t_min_ns=10_000_000, t_max_ns=200_000_000,
+        dur_min_ns=50_000_000, dur_max_ns=200_000_000,
+        mult_min=4, mult_max=16,
+    ),
+), name="paxos-nemesis")
+
+# twophase: participant crash + message duplication (idempotency check)
+TP_PLAN = FaultPlan((
+    CrashStorm(
+        targets=(1, 2, 3, 4), n=1,
+        t_min_ns=20_000_000, t_max_ns=250_000_000,
+        down_min_ns=100_000_000, down_max_ns=400_000_000,
+    ),
+    Duplicate(
+        t_min_ns=10_000_000, t_max_ns=300_000_000,
+        dur_min_ns=50_000_000, dur_max_ns=300_000_000,
+    ),
+), name="twophase-nemesis")
 
 
 def kv_hinv(box):
@@ -207,6 +279,80 @@ def main() -> None:
         failures.append("raftlog-nemesis")
     if nh:
         failures.append("raftlog-nemesis-unhalted")
+
+    # ---- certificate 5: raft election under a pause-storm plan ----
+    # pauses hold events without wiping votedFor (the state kills would
+    # wipe), so at-most-one-winner-per-term must hold exactly
+    t0 = time.monotonic()
+    box = {}
+
+    def relect_inv(h):
+        box["ok"] = election_safety(h, elect_op=R_OP_ELECT)
+        return box["ok"]
+
+    rep = search_seeds(
+        make_raft(record=True),
+        EngineConfig(pool_size=64, loss_p=0.02),
+        None, n_seeds=n_seeds, max_steps=2000,
+        history_invariant=relect_inv, plan=RAFT_EL_PLAN,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"raft election under nemesis ({RAFT_EL_PLAN.hash()}): {nv} "
+          f"election-safety violations, {no} overflows, {nh} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if nv or no or nh:
+        failures.append("raft-election-nemesis")
+
+    # ---- certificate 6: paxos agreement under a proposer crash storm ----
+    t0 = time.monotonic()
+    box = {}
+
+    def paxos_inv(h):
+        box["ok"] = election_safety(h, elect_op=PX_OP_DECIDE)
+        return box["ok"]
+
+    rep = search_seeds(
+        make_paxos(record=True, chaos=False),
+        EngineConfig(pool_size=96, loss_p=0.05),
+        None, n_seeds=n_seeds, max_steps=4000,
+        history_invariant=paxos_inv, plan=PAXOS_PLAN,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"paxos under nemesis ({PAXOS_PLAN.hash()}): {nv} agreement "
+          f"violations, {no} overflows, {nh} unhalted "
+          f"({time.monotonic() - t0:.1f}s)")
+    if nv or no or nh:
+        failures.append("paxos-nemesis")
+
+    # ---- certificate 7: twophase atomicity under crash + duplication ----
+    # liveness is deliberately NOT asserted (docstring: without the
+    # built-in chaos hook the coordinator has no loss-free RESYNC, so a
+    # crash-after-ack can stall); atomicity must hold regardless
+    t0 = time.monotonic()
+    box = {}
+
+    def tp_inv(h):
+        box["ok"] = election_safety(h, elect_op=TP_OP_DECIDE)
+        return box["ok"]
+
+    rep = search_seeds(
+        make_twophase(record=True, chaos=False),
+        EngineConfig(pool_size=96, loss_p=0.05),
+        None, n_seeds=n_seeds, max_steps=4000,
+        history_invariant=tp_inv, plan=TP_PLAN, require_halt=False,
+    )
+    nv = int((~box["ok"] & ~rep.overflowed).sum())
+    no = int(rep.overflowed.sum())
+    nh = int((~np.asarray(rep.halted)).sum())
+    print(f"twophase under nemesis ({TP_PLAN.hash()}): {nv} atomicity "
+          f"violations, {no} overflows, {nh} unhalted (liveness not "
+          f"asserted) ({time.monotonic() - t0:.1f}s)")
+    if nv or no:
+        failures.append("twophase-nemesis")
 
     verdict = "PASS" if not failures else f"FAIL ({', '.join(failures)})"
     print(f"# verdict: {verdict} — declarative nemesis amplifies chaos, "

@@ -24,7 +24,7 @@ SHELL   := /bin/bash
 # bash, not sh: the tier1 recipe uses `set -o pipefail`/PIPESTATUS
 
 .PHONY: check check-full native test test-full tier1 determinism \
-        bench-smoke bench-tpu-snapshot nemesis-soak explore clean
+        bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -80,6 +80,15 @@ nemesis-soak:
 EXPLORE_BUDGET ?= 2048
 explore:
 	$(PY) tools/explore_soak.py $(EXPLORE_BUDGET)
+
+# Observability soak (madsim_tpu.obs): obs-off identity at soak scale,
+# device-reduced fleet metrics on OBS_SEEDS seeds, the raftlog
+# violation shrunk + replayed with the timeline ring and exported as
+# Perfetto trace-event JSON, campaign telemetry/persistence, and the
+# guided-vs-uniform delta under AFL hit-count bucketing.
+OBS_SEEDS ?= 8192
+obs-soak:
+	$(PY) tools/obs_soak.py $(OBS_SEEDS)
 
 # Session-start TPU capture: the TPU tunnel historically wedges
 # mid-session, so grab the round's accelerator numbers FIRST (same

@@ -37,6 +37,13 @@ from .core import (  # noqa: E402,F401
     KIND_UNCLOG_1W,
     KIND_UNCLOG_NODE,
     KIND_UNSLOW,
+    HALT_DONE,
+    HALT_IDLE,
+    HALT_RUNNING,
+    HALT_TIME_LIMIT,
+    MET_HALT_CODE,
+    METRIC_NAMES,
+    N_METRICS,
     EmitBuilder,
     Emits,
     EngineConfig,

@@ -77,6 +77,17 @@ RESULT_FIELDS = (
     "hist_t",
     # coverage bitmap (madsim_tpu.explore): zero-size with cov_words=0
     "cov",
+    # observability columns (madsim_tpu.obs): all zero-size when the
+    # metrics/timeline taps are off. cov_hits is deliberately NOT banked
+    # — guidance consumes only the bitmap, and the counters would add
+    # CW*32 bytes/seed of transfer for nothing.
+    "met",
+    "tl_count",
+    "tl_drop",
+    "tl_t",
+    "tl_meta",
+    "tl_args",
+    "tl_pay",
 )
 
 
@@ -98,6 +109,9 @@ def make_run_compacted(
     fields: tuple = RESULT_FIELDS,
     dup_rows: bool = False,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -111,7 +125,10 @@ def make_run_compacted(
     ``min_size >= n_seeds`` the program degenerates to exactly one
     while_loop — the plain ``make_run_while``.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
+    step = jax.vmap(make_step(
+        wl, cfg, layout, time32, dup_rows, cov_words,
+        metrics, timeline_cap, cov_hitcount,
+    ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:
         if f not in all_names:

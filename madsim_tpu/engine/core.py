@@ -78,6 +78,13 @@ __all__ = [
     "EmitBuilder",
     "HandlerCtx",
     "PlanRows",
+    "METRIC_NAMES",
+    "N_METRICS",
+    "MET_HALT_CODE",
+    "HALT_RUNNING",
+    "HALT_DONE",
+    "HALT_TIME_LIMIT",
+    "HALT_IDLE",
     "KIND_KILL",
     "KIND_RESTART",
     "KIND_CLOG",
@@ -203,6 +210,46 @@ KIND_SKEW = 248  # args[0]=node args[1]=skew_ns: the node's clock reads
 #                  now+skew (what its handlers observe as ctx.now)
 KIND_CLOG_1W = 249  # args[0]=src args[1]=dst — asymmetric partition edge
 KIND_UNCLOG_1W = 250
+
+
+# ---------------------------------------------------------------------------
+# Fleet-metric slot layout (madsim_tpu.obs). SimState.met is an
+# (N_METRICS,) int32 vector per seed when the step is built with
+# ``metrics=True`` (else zero-size). Every slot except MET_HALT_CODE is
+# a monotone counter folded at dispatch time; all of them are derived
+# from values the step already computes — no RNG draws, no feedback into
+# the trajectory, so metrics-off runs are bit-identical (the cov_words
+# discipline applied again). The obs package reduces these columns on
+# device (obs.fleet_reduce) so a 65k-seed sweep reports fleet histograms
+# without moving per-seed state to the host.
+# ---------------------------------------------------------------------------
+MET_SENT = 0  # messages sent (valid send emits at dispatch, lost or not)
+MET_DELIVERED = 1  # message deliveries dispatched (src >= 0)
+MET_LOST = 2  # sends dropped by the loss draw
+MET_DEAD_DROP = 3  # sends dropped because the dst was dead at send time
+MET_DUP = 4  # duplicated deliveries inserted (chaos KIND_DUP_ON)
+MET_CRASH = 5  # KIND_KILL dispatches
+MET_RESTART = 6  # KIND_RESTART dispatches
+MET_PAUSE = 7  # KIND_PAUSE dispatches
+MET_CLOG_BLOCK = 8  # delivery attempts held by a clogged link (each
+#                     backoff retry counts again — it is an attempt)
+MET_TIMER = 9  # user timer fires (non-message user dispatches)
+MET_RECORD = 10  # history records appended
+MET_RNG = 11  # threefry blocks drawn while the seed was active
+MET_HALT_CODE = 12  # not a counter: HALT_* code of how the seed stopped
+N_METRICS = 13
+
+METRIC_NAMES = (
+    "sent", "delivered", "lost", "dead_drop", "dup", "crash", "restart",
+    "pause", "clog_block", "timer", "record", "rng_blocks", "halt_code",
+)
+
+# MET_HALT_CODE values
+HALT_RUNNING = 0  # still live (or stopped only by the step cap)
+HALT_DONE = 1  # workload emitted KIND_HALT: scenario complete
+HALT_TIME_LIMIT = 2  # cfg.time_limit_ns tripped
+HALT_IDLE = 3  # event pool ran empty while unhalted (a deadlocked seed:
+#                nothing pending, nothing will ever be)
 
 
 def pack_slow_arg(b, mult):
@@ -695,6 +742,28 @@ class SimState:
     # values, so it never feeds back into the trajectory or the trace.
     cov: jnp.ndarray  # (CW,) uint32 coverage bitmap words
     cov_last: jnp.ndarray  # (N,) int32 last user kind per node (CW>0), else (0,)
+    # AFL-style hit-count counters (cov_hitcount=True, madsim_tpu.obs):
+    # one saturating uint8 per bitmap bit position; a feature's bit is
+    # then keyed by (feature, hit-count bucket class) so a behavior
+    # happening an order of magnitude more often is NEW coverage. Empty
+    # (0,) when the bucketing flag is off — set-only bitmaps unchanged.
+    cov_hits: jnp.ndarray  # (CW*32,) uint8 when hit-counting, else (0,)
+    # fleet metrics (madsim_tpu.obs, metrics=True): see the MET_* slot
+    # layout above. (0,) when off — derived state only, like cov.
+    met: jnp.ndarray  # (N_METRICS,) int32 counters + the halt code
+    # per-seed timeline ring (madsim_tpu.obs, timeline_cap=T): the
+    # dispatched-event stream, exactly the tuples the trace hash folds
+    # (time, packed meta, args, payload). A full
+    # ring drops LOUDLY via tl_drop, the hist_drop rule again: the
+    # timeline is forensics, not evidence, so a drop never quarantines
+    # the seed's verdict — but the search banner surfaces it.
+    tl_count: jnp.ndarray  # () int32 events recorded
+    tl_drop: jnp.ndarray  # () int32 events dropped at capacity
+    tl_t: jnp.ndarray  # (T,) int64 dispatch clock ns (unskewed)
+    tl_meta: jnp.ndarray  # (T,) uint32 packed kind/node/src (ev_meta layout)
+    tl_args: jnp.ndarray  # (T, A) int32 event args
+    tl_pay: jnp.ndarray  # (T, W) int32 payload words — so the decoded
+    # stream refolds to the certified trace for payload workloads too
 
     @property
     def sim_seconds(self):
@@ -773,12 +842,27 @@ def _check_cov_words(cov_words: int) -> None:
         )
 
 
+def _check_obs(cov_words: int, cov_hitcount: bool, timeline_cap: int) -> None:
+    """Observability build-parameter validation — shared by make_init and
+    make_step so no mismatched pair of builders can be constructed."""
+    if cov_hitcount and not cov_words:
+        raise ValueError(
+            "cov_hitcount=True needs coverage enabled (cov_words > 0): "
+            "hit-count buckets refine the coverage bitmap"
+        )
+    if timeline_cap < 0:
+        raise ValueError(f"timeline_cap={timeline_cap} must be >= 0")
+
+
 def make_init(
     wl: Workload,
     cfg: EngineConfig,
     time32: bool | None = None,
     plan_slots: int = 0,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -794,6 +878,11 @@ def make_init(
     ``cov_words=CW`` sizes the per-seed coverage bitmap (CW*32 bits,
     madsim_tpu.explore); must match the step builder's value. 0 (the
     default) compiles recording away entirely.
+
+    ``metrics``/``timeline_cap``/``cov_hitcount`` size the observability
+    columns (madsim_tpu.obs; see the make_step docstring); each must
+    match the step builder's value, and each defaults to off (zero-size
+    arrays, zero cost, bit-identical values).
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     p = plan_slots
@@ -804,6 +893,7 @@ def make_init(
         )
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
+    _check_obs(cov_words, cov_hitcount, timeline_cap)
     del k
     w = wl.payload_words
     h = wl.history.capacity if wl.history is not None else 0
@@ -864,6 +954,16 @@ def make_init(
             hist_t=jnp.zeros((h,), jnp.int64),
             cov=jnp.zeros((cov_words,), jnp.uint32),
             cov_last=jnp.zeros((n if cov_words else 0,), jnp.int32),
+            cov_hits=jnp.zeros(
+                (cov_words * 32 if cov_hitcount else 0,), jnp.uint8
+            ),
+            met=jnp.zeros((N_METRICS if metrics else 0,), jnp.int32),
+            tl_count=jnp.int32(0),
+            tl_drop=jnp.int32(0),
+            tl_t=jnp.zeros((timeline_cap,), jnp.int64),
+            tl_meta=jnp.zeros((timeline_cap,), jnp.uint32),
+            tl_args=jnp.zeros((timeline_cap, wl.args_words), jnp.int32),
+            tl_pay=jnp.zeros((timeline_cap, w), jnp.int32),
         )
 
     def init(seeds, plan: PlanRows | None = None) -> SimState:
@@ -917,6 +1017,9 @@ def make_step(
     time32: bool | None = None,
     dup_rows: bool = False,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -964,6 +1067,27 @@ def make_step(
     and leader changes become bits). Coverage is derived state only:
     with CW=0 (default) the block compiles away and values are
     bit-identical to the pre-coverage step.
+
+    The three observability taps (madsim_tpu.obs) follow the exact same
+    derived-state-only discipline — off (the defaults) means zero-size
+    arrays, zero ops, bit-identical values; on means extra columns that
+    never feed back into the trajectory, the RNG, or the trace:
+
+    * ``metrics=True`` folds the MET_* fleet counters (messages sent/
+      delivered/lost, crashes, pauses, clog-blocked attempts, timer
+      fires, history records, RNG blocks, halt reason) into
+      ``SimState.met`` per seed, sized for on-device fleet reduction.
+    * ``timeline_cap=T`` records the dispatched-event stream — the
+      tuples the trace hash folds — into a T-slot ring per seed
+      (``tl_t``/``tl_meta``/``tl_args``), overflow counted loudly in
+      ``tl_drop``. Decoded host-side by ``obs.decode_timeline``.
+    * ``cov_hitcount=True`` upgrades the coverage taps from set-only to
+      AFL-style hit-count bucketing: each feature keeps a saturating
+      per-seed counter (``cov_hits``) and its bitmap bit is keyed by
+      (feature, bucket class 1/2/3/4-7/8-15/16-31/32-127/128+), so a
+      behavior recurring an order of magnitude more often is new
+      coverage. Changes which bits mean what — campaigns must not mix
+      flag states — but never the trajectory.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -983,6 +1107,7 @@ def make_step(
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
+    _check_obs(cov_words, cov_hitcount, timeline_cap)
     if layout is None:
         layout = "scatter" if jax.default_backend() == "cpu" else "dense"
     if layout not in ("dense", "scatter"):
@@ -1589,6 +1714,55 @@ def make_step(
                 m = jnp.uint32(1) << (bit & jnp.uint32(31))
                 return cov_acc | jnp.where(sel & on, m, jnp.uint32(0))
 
+            if cov_hitcount:
+                # AFL-style bucketing (madsim_tpu.obs): a saturating
+                # per-seed counter per bitmap bit position; the bit a
+                # feature sets is keyed by (feature, bucket class), so
+                # crossing 1 -> 2 -> 4-7 -> ... occurrences keeps
+                # setting fresh bits. Same dense/scatter duality and
+                # derived-state-only rule as everything here.
+                cb_n = cov_words * 32
+                cb_ids = jnp.arange(cb_n, dtype=jnp.uint32)
+                # AFL's class boundaries: 1,2,3,4-7,8-15,16-31,32-127,128+
+                _cls_edges = (1, 2, 3, 4, 8, 16, 32, 128)
+
+                def _tap(cov_acc, hits, feat, on):
+                    ci = _cov_mix(feat) & jnp.uint32(cb_n - 1)
+                    if dense:
+                        cur = jnp.sum(
+                            jnp.where(cb_ids == ci, hits, 0)
+                        ).astype(jnp.int32)
+                    else:
+                        cur = hits[ci].astype(jnp.int32)
+                    newc = jnp.minimum(cur + 1, 255)
+                    cls = (
+                        sum(
+                            (newc >= t).astype(jnp.uint32)
+                            for t in _cls_edges
+                        )
+                        - jnp.uint32(1)
+                    )
+                    if dense:
+                        hits = jnp.where(
+                            (cb_ids == ci) & on,
+                            newc.astype(jnp.uint8),
+                            hits,
+                        )
+                    else:
+                        hits = hits.at[
+                            jnp.where(on, ci, jnp.uint32(cb_n))
+                        ].set(newc.astype(jnp.uint8), mode="drop")
+                    feat2 = feat ^ (
+                        (cls + jnp.uint32(1)) * jnp.uint32(0x9E3779B9)
+                    )
+                    return _cov_set(cov_acc, feat2, on), hits
+
+            else:
+
+                def _tap(cov_acc, hits, feat, on):
+                    return _cov_set(cov_acc, feat, on), hits
+
+            cov_hits = st.cov_hits
             # per-node event-kind transition pair (prev -> kind at dst)
             if dense:
                 prev_kind = jnp.sum(
@@ -1601,7 +1775,7 @@ def make_step(
                 | (prev_kind.astype(jnp.uint32) << jnp.uint32(8))
                 | (jnp.maximum(dst, 0).astype(jnp.uint32) << jnp.uint32(16))
             )
-            cov = _cov_set(st.cov, f_user, user_dispatch)
+            cov, cov_hits = _tap(st.cov, cov_hits, f_user, user_dispatch)
             # coarse time phase (~134 ms buckets): behaviors that recur
             # in NEW phases are new bits, which keeps long/late
             # trajectories distinguishable from early ones
@@ -1615,7 +1789,7 @@ def make_step(
                 | (phase << jnp.uint32(8))
                 | jnp.uint32(1 << 24)
             )
-            cov = _cov_set(cov, f_chaos, dispatch & is_engine)
+            cov, cov_hits = _tap(cov, cov_hits, f_chaos, dispatch & is_engine)
             # message edge (kind, src -> dst): which protocol messages
             # flowed between which nodes — partitions and gray failures
             # reshape exactly this
@@ -1625,7 +1799,7 @@ def make_step(
                 | (jnp.maximum(dst, 0).astype(jnp.uint32) << jnp.uint32(16))
                 | jnp.uint32(3 << 24)
             )
-            cov = _cov_set(cov, f_edge, user_dispatch & is_msg)
+            cov, cov_hits = _tap(cov, cov_hits, f_edge, user_dispatch & is_msg)
             # user kind x phase: WHEN the protocol did something, not
             # just that it did — a second election at 500 ms is a
             # different behavior than the first at 200 ms
@@ -1634,7 +1808,7 @@ def make_step(
                 | (phase << jnp.uint32(8))
                 | jnp.uint32(4 << 24)
             )
-            cov = _cov_set(cov, f_when, user_dispatch)
+            cov, cov_hits = _tap(cov, cov_hits, f_when, user_dispatch)
             # appended history records: (op, key, arg, ok) words — term
             # bumps, elected leaders, committed (index, value) pairs
             for j in range(rr):
@@ -1645,7 +1819,9 @@ def make_step(
                     ^ uem.rec[j, 3].astype(jnp.uint32)
                     ^ jnp.uint32(2 << 24)
                 )
-                cov = _cov_set(cov, f_rec, user_dispatch & uem.rec_valid[j])
+                cov, cov_hits = _tap(
+                    cov, cov_hits, f_rec, user_dispatch & uem.rec_valid[j]
+                )
             if dense:
                 cov_last = jnp.where(
                     dst_oh & user_dispatch, kind, st.cov_last
@@ -1655,7 +1831,91 @@ def make_step(
                     jnp.where(in_range & user_dispatch, dst_c, jnp.int32(n))
                 ].set(kind, mode="drop")
         else:
-            cov, cov_last = st.cov, st.cov_last
+            cov, cov_last, cov_hits = st.cov, st.cov_last, st.cov_hits
+
+        # ---- fleet metrics (madsim_tpu.obs) ----
+        # every operand below is a value the step already computed, and
+        # nothing written here is ever read by the trajectory — the
+        # derived-state-only rule the obs-off identity test pins.
+        if metrics:
+            i32 = lambda b: jnp.sum(b).astype(jnp.int32)  # noqa: E731
+            sent_m = dispatch & em.valid & em.send
+            inc = [jnp.int32(0)] * N_METRICS
+            inc[MET_SENT] = i32(sent_m)
+            inc[MET_DELIVERED] = (dispatch & is_msg).astype(jnp.int32)
+            inc[MET_LOST] = i32(sent_m & lost)
+            inc[MET_DEAD_DROP] = i32(sent_m & ~lost & ~alive_at_dst)
+            if dup_rows:
+                # shadow rows are the last K emit slots (the dup block)
+                inc[MET_DUP] = i32(e_valid[k + 1:])
+            inc[MET_CRASH] = (dispatch & (kind == KIND_KILL)).astype(jnp.int32)
+            inc[MET_RESTART] = (
+                dispatch & (kind == KIND_RESTART)
+            ).astype(jnp.int32)
+            inc[MET_PAUSE] = (dispatch & (kind == KIND_PAUSE)).astype(jnp.int32)
+            inc[MET_CLOG_BLOCK] = (active & clogged).astype(jnp.int32)
+            inc[MET_TIMER] = (user_dispatch & ~is_msg).astype(jnp.int32)
+            if hcap > 0:
+                inc[MET_RECORD] = i32(keep)
+            # threefry blocks per active event step: the poll-cost/jitter
+            # pair + one latency/loss block per emit slot (+ the dup
+            # shadow slots when compiled) — a static count, so this is
+            # bookkeeping, not instrumentation of the RNG itself
+            blocks = 1 + (k + 1) + (k if dup_rows else 0)
+            inc[MET_RNG] = jnp.where(active, jnp.int32(blocks), 0)
+            met = st.met + jnp.stack(inc)
+            new_halt = halted & ~st.halted
+            code = jnp.where(
+                dispatch & (kind == KIND_HALT),
+                jnp.int32(HALT_DONE),
+                jnp.int32(HALT_TIME_LIMIT),
+            )
+            cur_code = met[MET_HALT_CODE]
+            # an empty pool on an unhalted seed is terminal (nothing
+            # pending can create events): record it as a deadlock code
+            idle = ~has_event & ~st.halted
+            met = met.at[MET_HALT_CODE].set(
+                jnp.where(
+                    new_halt,
+                    code,
+                    jnp.where(
+                        idle & (cur_code == HALT_RUNNING),
+                        jnp.int32(HALT_IDLE),
+                        cur_code,
+                    ),
+                )
+            )
+        else:
+            met = st.met
+
+        # ---- timeline ring (madsim_tpu.obs) ----
+        # the dispatched-event stream, one row per dispatch: exactly the
+        # (now, kind, node, src, args) tuple the trace hash folds, so a
+        # decoded timeline refolds to the certified trace (obs.refold,
+        # payload-free workloads). Same compact-append duality as the
+        # history columns; a full ring counts drops loudly in tl_drop.
+        if timeline_cap:
+            tfits = st.tl_count < timeline_cap
+            t_do = dispatch & tfits
+            if dense:
+                tl_ids = jnp.arange(timeline_cap, dtype=jnp.int32)
+                t_sel = (tl_ids == st.tl_count) & t_do
+                tl_t = jnp.where(t_sel, now, st.tl_t)
+                tl_meta = jnp.where(t_sel, meta_i, st.tl_meta)
+                tl_args = jnp.where(t_sel[:, None], args[None, :], st.tl_args)
+                tl_pay = jnp.where(t_sel[:, None], pay_i[None, :], st.tl_pay)
+            else:
+                t_slot = jnp.where(t_do, st.tl_count, jnp.int32(timeline_cap))
+                tl_t = st.tl_t.at[t_slot].set(now, mode="drop")
+                tl_meta = st.tl_meta.at[t_slot].set(meta_i, mode="drop")
+                tl_args = st.tl_args.at[t_slot].set(args, mode="drop")
+                tl_pay = st.tl_pay.at[t_slot].set(pay_i, mode="drop")
+            tl_count = st.tl_count + t_do.astype(jnp.int32)
+            tl_drop = st.tl_drop + (dispatch & ~tfits).astype(jnp.int32)
+        else:
+            tl_count, tl_drop = st.tl_count, st.tl_drop
+            tl_t, tl_meta, tl_args = st.tl_t, st.tl_meta, st.tl_args
+            tl_pay = st.tl_pay
 
         # ---- trace + clock ----
         trace = jnp.where(
@@ -1692,6 +1952,14 @@ def make_step(
             hist_t=hist_t,
             cov=cov,
             cov_last=cov_last,
+            cov_hits=cov_hits,
+            met=met,
+            tl_count=tl_count,
+            tl_drop=tl_drop,
+            tl_t=tl_t,
+            tl_meta=tl_meta,
+            tl_args=tl_args,
+            tl_pay=tl_pay,
         )
 
     return step
@@ -1705,6 +1973,9 @@ def make_run(
     time32: bool | None = None,
     dup_rows: bool = False,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -1720,7 +1991,10 @@ def make_run(
     check ``overflow == 0`` before trusting per-seed results (bench.py
     and engine.search do; direct callers are responsible themselves).
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
+    step = jax.vmap(make_step(
+        wl, cfg, layout, time32, dup_rows, cov_words,
+        metrics, timeline_cap, cov_hitcount,
+    ))
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -1740,6 +2014,9 @@ def make_run_while(
     time32: bool | None = None,
     dup_rows: bool = False,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -1755,7 +2032,10 @@ def make_run_while(
     silently continues — check ``overflow == 0`` before trusting
     per-seed results.
     """
-    step = jax.vmap(make_step(wl, cfg, layout, time32, dup_rows, cov_words))
+    step = jax.vmap(make_step(
+        wl, cfg, layout, time32, dup_rows, cov_words,
+        metrics, timeline_cap, cov_hitcount,
+    ))
 
     def run(state: SimState) -> SimState:
         def cond(carry):

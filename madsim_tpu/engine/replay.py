@@ -28,7 +28,14 @@ import numpy as np
 
 from . import core as _core
 from . import oracle as _oracle
-from .core import FIRST_USER_KIND, _TRACE_MIX, _TRACE_PRIME, EngineConfig, Workload
+from .core import (
+    FIRST_EXT_KIND,
+    FIRST_USER_KIND,
+    _TRACE_MIX,
+    _TRACE_PRIME,
+    EngineConfig,
+    Workload,
+)
 
 __all__ = ["ReplayEvent", "replay", "refold", "format_timeline"]
 
@@ -55,7 +62,10 @@ class ReplayEvent:
     pay: tuple
 
     def kind_name(self, wl: Workload | None = None) -> str:
-        if self.kind < FIRST_USER_KIND:
+        # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too
+        # — without this clause a plan-driven timeline would label a
+        # SLOW_LINK as user[234]
+        if self.kind < FIRST_USER_KIND or self.kind >= FIRST_EXT_KIND:
             return _ENGINE_KIND_NAMES.get(self.kind, f"engine[{self.kind}]")
         u = self.kind - FIRST_USER_KIND
         names = getattr(wl, "handler_names", None) if wl is not None else None

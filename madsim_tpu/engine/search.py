@@ -56,25 +56,31 @@ _RUN_CACHE: dict = {}
 
 def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   compact: bool, plan_slots: int = 0, dup_rows: bool = False,
-                  cov_words: int = 0):
+                  cov_words: int = 0, metrics: bool = False,
+                  timeline_cap: int = 0, cov_hitcount: bool = False):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
-           dup_rows, cov_words)
+           dup_rows, cov_words, metrics, timeline_cap, cov_hitcount)
     if key not in _RUN_CACHE:
+        obs_kw = dict(
+            metrics=metrics, timeline_cap=timeline_cap,
+            cov_hitcount=cov_hitcount,
+        )
         if compact:
             run = make_run_compacted(
                 wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-                cov_words=cov_words,
+                cov_words=cov_words, **obs_kw,
             )
         else:
             run = jax.jit(make_run_while(
                 wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-                cov_words=cov_words,
+                cov_words=cov_words, **obs_kw,
             ))
         _RUN_CACHE[key] = (
-            make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words),
+            make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
+                      **obs_kw),
             run,
             wl,  # keep the workload alive so id() stays unique
         )
@@ -107,6 +113,23 @@ class SearchReport:
     # (S,) int64 per-seed halt clock (0 while running) — the causal
     # horizon explore's mutators use to avoid perturbing post-halt slots
     halt_times: np.ndarray | None = None
+    # observability columns (madsim_tpu.obs) — None unless the sweep
+    # ran with the corresponding tap enabled:
+    # (S, N_METRICS) per-seed fleet counters (metrics=True); reduce
+    # fleet-wide with obs.fleet_reduce
+    met: np.ndarray | None = None
+    # per-seed timeline ring views (timeline_cap > 0): a namespace of
+    # tl_count/tl_drop/tl_t/tl_meta/tl_args arrays, each seed-leading;
+    # decode one seed's stream with obs.decode_timeline(report.timeline,
+    # wl, i)
+    timeline: object | None = None
+    # overflow breakdown: which channel voided which seeds. overflowed
+    # stays the union the quarantine uses; tl_dropped does NOT void a
+    # verdict (the timeline is forensics, not evidence) but is loud in
+    # the banner
+    pool_overflowed: np.ndarray | None = None
+    hist_dropped: np.ndarray | None = None
+    tl_dropped: np.ndarray | None = None
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -131,17 +154,59 @@ class SearchReport:
         return self.seeds[self.overflowed]
 
     def banner(self, limit: int = 10) -> str:
-        """Repro recipe per failing seed (runtime/mod.rs:193-200 shape)."""
+        """Repro recipe per failing seed (runtime/mod.rs:193-200 shape),
+        with the per-seed halt/overflow breakdown when available."""
         bad = self.failing_seeds
+        s = len(self.seeds)
         lines = [
-            f"chaos search over {len(self.seeds)} seeds of "
+            f"chaos search over {s} seeds of "
             f"{self.workload!r}: {len(bad)} violation(s)",
         ]
+        n_halt = int(np.asarray(self.halted).sum())
+        if self.met is not None:
+            # metrics carry the per-seed halt reason (engine HALT_* codes)
+            from .core import (
+                HALT_DONE,
+                HALT_IDLE,
+                HALT_TIME_LIMIT,
+                MET_HALT_CODE,
+            )
+            codes = np.asarray(self.met)[:, MET_HALT_CODE]
+            done = int((codes == HALT_DONE).sum())
+            tlim = int((codes == HALT_TIME_LIMIT).sum())
+            idle = int((codes == HALT_IDLE).sum())
+            running = s - n_halt - idle
+            lines.append(
+                f"  halted {n_halt}/{s}: {done} workload-halt, "
+                f"{tlim} time-limit; {idle} idle (empty pool), "
+                f"{running} still running at the step cap"
+            )
+        elif n_halt < s:
+            lines.append(
+                f"  halted {n_halt}/{s}; {s - n_halt} still running at "
+                f"the step cap (run with metrics=True for the halt-"
+                f"reason breakdown)"
+            )
         if self.overflowed.any():
+            pool = (
+                int(np.asarray(self.pool_overflowed).sum())
+                if self.pool_overflowed is not None else 0
+            )
+            hist = (
+                int(np.asarray(self.hist_dropped).sum())
+                if self.hist_dropped is not None else 0
+            )
+            detail = f" (pool {pool}, history {hist})" if pool or hist else ""
             lines.append(
                 f"  WARNING: {int(self.overflowed.sum())} seed(s) "
-                f"overflowed the event pool or history buffer; excluded "
-                f"(raise pool_size / HistorySpec capacity)"
+                f"overflowed the event pool or history buffer{detail}; "
+                f"excluded (raise pool_size / HistorySpec capacity)"
+            )
+        if self.tl_dropped is not None and self.tl_dropped.any():
+            lines.append(
+                f"  WARNING: {int(self.tl_dropped.sum())} seed(s) "
+                f"overflowed the timeline ring (raise timeline_cap; "
+                f"verdicts unaffected — the timeline is forensics only)"
             )
         plan = f" plan_hash={self.plan_hash}" if self.plan_hash else ""
         for s in bad[:limit]:
@@ -181,6 +246,9 @@ def search_seeds(
     plan_hash: str | None = None,
     dup_rows: bool | None = None,
     cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -228,6 +296,15 @@ def search_seeds(
     ``dup_rows=True`` if any row uses duplication); ``cov_words=CW``
     runs the engine's coverage taps and returns the per-seed bitmaps
     as ``report.cov`` (S, CW).
+
+    The observability taps (madsim_tpu.obs) ride the same way:
+    ``metrics=True`` returns per-seed fleet counters as ``report.met``
+    (S, N_METRICS) and upgrades the banner with the halt-reason
+    breakdown; ``timeline_cap=T`` captures each seed's dispatched-event
+    stream (``report.timeline``, decode with ``obs.decode_timeline``);
+    ``cov_hitcount=True`` switches the coverage bitmaps to AFL-style
+    hit-count bucketing. All three are derived state only — the traces
+    and verdicts are bit-identical with them off or on.
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
@@ -266,7 +343,8 @@ def search_seeds(
         plan_slots = 0
         dup_rows = bool(dup_rows)
     init, run, _ = _compiled_run(
-        wl, cfg, max_steps, layout, compact, plan_slots, dup_rows, cov_words
+        wl, cfg, max_steps, layout, compact, plan_slots, dup_rows,
+        cov_words, metrics, timeline_cap, cov_hitcount,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):
@@ -300,7 +378,8 @@ def search_seeds(
             )
     else:
         ok = np.ones((n_seeds,), dtype=bool)
-    overflowed = np.asarray(view["overflow"]) > 0
+    pool_overflowed = np.asarray(view["overflow"]) > 0
+    overflowed = pool_overflowed
     if history_invariant is not None:
         # imported here: check is a consumer of the engine, not a
         # dependency (engine -> check at module import would be a cycle)
@@ -328,13 +407,26 @@ def search_seeds(
                 f"array, got shape {hok.shape}"
             )
         ok = ok & hok
+    hist_dropped = None
     if wl.history is not None:
         # dropped history records void the verdict (loud, like pool
         # overflow) whether or not a history predicate ran
-        overflowed = overflowed | (np.asarray(view["hist_drop"]) > 0)
+        hist_dropped = np.asarray(view["hist_drop"]) > 0
+        overflowed = overflowed | hist_dropped
     halted = view["halted"]
     if require_halt:
         ok = ok & halted
+    if timeline_cap:
+        from types import SimpleNamespace
+
+        tl = SimpleNamespace(**{
+            f: np.asarray(view[f])
+            for f in ("tl_count", "tl_drop", "tl_t", "tl_meta",
+                      "tl_args", "tl_pay")
+        })
+        tl_dropped = tl.tl_drop > 0
+    else:
+        tl, tl_dropped = None, None
     return SearchReport(
         workload=wl.name,
         config_hash=cfg.hash(),
@@ -347,4 +439,9 @@ def search_seeds(
         plan_hash=plan_hash or "",
         cov=np.asarray(view["cov"]) if cov_words else None,
         halt_times=np.asarray(view["halt_time"]),
+        met=np.asarray(view["met"]) if metrics else None,
+        timeline=tl,
+        pool_overflowed=pool_overflowed,
+        hist_dropped=hist_dropped,
+        tl_dropped=tl_dropped,
     )

@@ -32,16 +32,24 @@ from .driver import (  # noqa: F401
     run,
 )
 from .mutate import HostStream, PlanSpace, mutate_plan  # noqa: F401
+from .persist import (  # noqa: F401
+    CampaignState,
+    load_campaign,
+    save_campaign,
+)
 
 __all__ = [
+    "CampaignState",
     "CorpusEntry",
     "ExploreReport",
     "HostStream",
     "PlanSpace",
     "admit",
+    "load_campaign",
     "merge",
     "mutate_plan",
     "popcount",
     "replay_entry",
     "run",
+    "save_campaign",
 ]

@@ -77,7 +77,8 @@ class ExploreReport:
     config_hash: str
     plan_hash: str  # the plan space (generation-0 FaultPlan) hash
     root_seed: int
-    generations: int
+    generations: int  # ABSOLUTE campaign length (resumed runs include
+    # the generations a loaded checkpoint already executed)
     batch: int
     max_steps: int
     cov_words: int
@@ -87,6 +88,14 @@ class ExploreReport:
     cov_map: np.ndarray  # (CW,) uint32 final global coverage map
     curve: list  # coverage bits after each generation
     viol_curve: list  # cumulative violation count after each generation
+    # next CorpusEntry id — ids are consumed even by entries the full
+    # corpus refused, so persist (explore/persist.py) stores it rather
+    # than re-deriving from max(id)
+    next_id: int = 0
+    # whether the campaign's bitmaps used AFL hit-count bucketing
+    # (engine cov_hitcount): bucketed and set-only bitmaps are different
+    # coordinate systems, so resume refuses a flag mismatch
+    cov_hitcount: bool = False
 
     @property
     def coverage_bits(self) -> int:
@@ -182,6 +191,8 @@ def replay_entry(
     compact: bool = False,
     cov_words: int = 0,
     dup_rows: bool | None = None,
+    metrics: bool = False,
+    timeline_cap: int = 0,
 ) -> SearchReport:
     """Re-execute one corpus entry's exact ``(seed, plan)`` pair.
 
@@ -191,6 +202,9 @@ def replay_entry(
     guarantee tests and the soak assert. ``dup_rows`` defaults to what
     the entry's plan needs (the shrink_plan rule) — pass it explicitly
     only to replay under a differently compiled step on purpose.
+    ``metrics``/``timeline_cap`` turn on the observability taps
+    (madsim_tpu.obs) for the replay — the forensics path: derived state
+    only, so the replayed trace still equals ``entry.trace``.
     """
     if dup_rows is None:
         dup_rows = bool(entry.plan.uses_dup())
@@ -205,7 +219,7 @@ def replay_entry(
         compact=compact, history_invariant=history_invariant,
         plan_rows=stack_plan_rows([entry.plan]),
         plan_hash=entry.plan.hash(), dup_rows=dup_rows,
-        cov_words=cov_words,
+        cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
     )
 
 
@@ -230,6 +244,10 @@ def run(
     max_ops: int = 3,
     inherit_seed_p: float = 0.75,
     log=None,
+    cov_hitcount: bool = False,
+    telemetry=None,
+    resume=None,
+    checkpoint_path: str | None = None,
 ) -> ExploreReport:
     """Run one coverage-guided exploration campaign.
 
@@ -245,7 +263,27 @@ def run(
     fixed protocol trajectory) instead of drawing a fresh one (explore
     seed space). ``log`` (callable, e.g. ``print``) gets one line per
     generation.
+
+    ``cov_hitcount=True`` runs the engine's AFL-style hit-count
+    bucketing (make_step docstring): recurrence-magnitude changes
+    become fresh coverage, at the cost of a per-seed counter column.
+
+    ``telemetry`` (callable, e.g. ``obs.JsonlSink(path)``) receives one
+    structured record per campaign event: a ``campaign_start``, one
+    ``generation`` per generation (coverage bits, corpus size,
+    violations, dispatch wall seconds), and a ``campaign_end``.
+
+    ``resume`` (an ``explore.CampaignState`` or a path to one)
+    continues a checkpointed campaign: THIS call runs ``generations``
+    MORE generations on top of the loaded corpus/coverage/dedup state.
+    Draw keys are addressed by absolute generation index, so a resumed
+    campaign is bit-identical to the uninterrupted one given the same
+    (root seed, batch, space, config) — all validated against the
+    checkpoint. ``checkpoint_path`` saves the campaign state after
+    every generation (and is the natural ``resume`` input later).
     """
+    import time as _time
+
     if isinstance(space, FaultPlan):
         space = PlanSpace(space)
     if cov_words < 1:
@@ -257,17 +295,73 @@ def run(
             f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
         )
     dup = space.uses_dup()
-    global_map = np.zeros((cov_words,), np.uint32)
-    corpus: list[CorpusEntry] = []
-    by_id: dict[int, CorpusEntry] = {}
-    violations: list[CorpusEntry] = []
-    seen_viol: set = set()  # (seed, trace) — a violation is counted once
-    curve: list[int] = []
-    viol_curve: list[int] = []
-    next_id = 0
-    sims = 0
+    if resume is not None:
+        from .persist import CampaignState
 
-    for g in range(generations):
+        st = CampaignState.load(resume) if isinstance(resume, str) else resume
+        for what, got, want in (
+            ("workload", st.workload, wl.name),
+            ("plan-space hash", st.plan_hash, space.hash()),
+            ("config hash", st.config_hash, cfg.hash()),
+            ("root seed", st.root_seed, int(root_seed)),
+            ("batch", st.batch, batch),
+            ("cov_words", st.cov_words, cov_words),
+            ("cov_hitcount", st.cov_hitcount, cov_hitcount),
+        ):
+            if got != want:
+                raise ValueError(
+                    f"campaign checkpoint {what} mismatch: saved {got!r}, "
+                    f"this run has {want!r} — resuming would break the "
+                    f"pure-function-of-root-seed contract"
+                )
+        global_map = np.asarray(st.cov_map, np.uint32).copy()
+        corpus = list(st.corpus)
+        by_id = {e.id: e for e in corpus}
+        violations = list(st.violations)
+        seen_viol = {(e.seed, e.trace) for e in violations}
+        curve = list(st.curve)
+        viol_curve = list(st.viol_curve)
+        next_id = st.next_id
+        sims = st.sims
+        g_start = st.generations_done
+    else:
+        global_map = np.zeros((cov_words,), np.uint32)
+        corpus = []
+        by_id = {}
+        violations = []
+        seen_viol = set()  # (seed, trace) — a violation is counted once
+        curve = []
+        viol_curve = []
+        next_id = 0
+        sims = 0
+        g_start = 0
+
+    def _snapshot(gens_done: int):
+        from .persist import CampaignState
+
+        return CampaignState(
+            workload=wl.name, config_hash=cfg.hash(),
+            plan_hash=space.hash(), root_seed=int(root_seed), batch=batch,
+            cov_words=cov_words, cov_hitcount=cov_hitcount,
+            generations_done=gens_done, next_id=next_id, sims=sims,
+            curve=list(curve), viol_curve=list(viol_curve),
+            cov_map=global_map.copy(), corpus=list(corpus),
+            violations=list(violations),
+        )
+
+    def _emit(record: dict):
+        if telemetry is not None:
+            telemetry(record)
+
+    _emit({
+        "event": "campaign_start", "workload": wl.name,
+        "config_hash": cfg.hash(), "plan_hash": space.hash(),
+        "root_seed": int(root_seed), "batch": batch,
+        "generations": generations, "cov_words": cov_words,
+        "cov_hitcount": cov_hitcount, "resumed_at_generation": g_start,
+    })
+
+    for g in range(g_start, g_start + generations):
         k0s, k1s = _derive_keys(root_seed, g, batch)
         seeds = _child_seeds(k0s, k1s)
         overrides: dict[int, LiteralPlan] = {}
@@ -326,14 +420,16 @@ def run(
                 )
             rows = stack_plan_rows(plans)
 
+        t_disp = _time.monotonic()
         report = search_seeds(
             wl, cfg, invariant,
             seeds=seeds, max_steps=max_steps, require_halt=require_halt,
             layout=layout, compact=compact,
             history_invariant=history_invariant,
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
-            cov_words=cov_words,
+            cov_words=cov_words, cov_hitcount=cov_hitcount,
         )
+        dispatch_wall = _time.monotonic() - t_disp
         sims += batch
         failing = ~report.ok & ~report.overflowed
         # overflowed seeds are quarantined from guidance too: their
@@ -378,13 +474,26 @@ def run(
                 f"corpus entries, corpus {len(corpus)}), "
                 f"{len(violations)} violations"
             )
+        _emit({
+            "event": "generation", "generation": g, "sims": sims,
+            "cov_bits": curve[-1], "new_entries": admitted,
+            "corpus_size": len(corpus), "violations": len(violations),
+            "dispatch_wall_s": round(dispatch_wall, 3),
+        })
+        if checkpoint_path is not None:
+            _snapshot(g + 1).save(checkpoint_path)
 
+    _emit({
+        "event": "campaign_end", "generations": g_start + generations,
+        "sims": sims, "cov_bits": curve[-1] if curve else 0,
+        "corpus_size": len(corpus), "violations": len(violations),
+    })
     return ExploreReport(
         workload=wl.name,
         config_hash=cfg.hash(),
         plan_hash=space.hash(),
         root_seed=int(root_seed),
-        generations=generations,
+        generations=g_start + generations,
         batch=batch,
         max_steps=max_steps,
         cov_words=cov_words,
@@ -394,4 +503,6 @@ def run(
         cov_map=global_map,
         curve=curve,
         viol_curve=viol_curve,
+        next_id=next_id,
+        cov_hitcount=cov_hitcount,
     )

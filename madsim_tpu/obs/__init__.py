@@ -1,0 +1,61 @@
+"""madsim_tpu.obs — observability for the batched engine.
+
+The reference threads ``tracing`` spans through every node, task and
+network op (SURVEY.md §5); a 65k-seed batched sweep compresses all of
+that into a trace *hash* and a violation count. This package is the
+flight recorder that closes the gap, built on the engine's
+derived-state-only tap discipline (coverage proved the pattern: off =
+zero-size arrays and bit-identical values):
+
+* **fleet metrics** (obs/metrics.py) — per-seed MET_* counters folded
+  in the step (``metrics=True``), reduced ON DEVICE to fleet totals,
+  log2 histograms and the halt-reason distribution; a sweep's shape
+  without per-seed transfer.
+* **timeline capture** (obs/timeline.py) — an opt-in per-seed event
+  ring (``timeline_cap=T``) recording the dispatched-event stream
+  (payload words included), decoded host-side against the workload's
+  kind table; the decoded timeline refolds to the certified trace hash.
+* **Perfetto export** (obs/perfetto.py) — ``to_perfetto`` renders a
+  captured timeline as trace-event JSON: per-node tracks, message flow
+  arrows, chaos-plan spans — a shrunk violation opens as a readable
+  timeline in ui.perfetto.dev.
+* **campaign telemetry** (obs/telemetry.py) — ``JsonlSink`` structured
+  progress for exploration campaigns and soaks, and ``explain``: the
+  per-violation narrative interleaving timeline, history ops and the
+  checker verdict.
+
+Evidence artifact: ``tools/obs_soak.py`` (OBS_r09.txt).
+"""
+
+from ..engine.core import (  # noqa: F401 — the slot layout obs consumes
+    HALT_DONE,
+    HALT_IDLE,
+    HALT_RUNNING,
+    HALT_TIME_LIMIT,
+    MET_HALT_CODE,
+    METRIC_NAMES,
+    N_METRICS,
+)
+from .metrics import FleetMetrics, fleet_metrics, fleet_reduce  # noqa: F401
+from .perfetto import to_perfetto, write_perfetto  # noqa: F401
+from .telemetry import JsonlSink, explain  # noqa: F401
+from .timeline import (  # noqa: F401
+    decode_timeline,
+    refold_timeline,
+    timeline_counts,
+)
+
+__all__ = [
+    "FleetMetrics",
+    "JsonlSink",
+    "METRIC_NAMES",
+    "N_METRICS",
+    "decode_timeline",
+    "explain",
+    "fleet_metrics",
+    "fleet_reduce",
+    "refold_timeline",
+    "timeline_counts",
+    "to_perfetto",
+    "write_perfetto",
+]

@@ -1,0 +1,244 @@
+"""Campaign telemetry + the per-violation ``explain`` narrative.
+
+Two consumers of the observability columns live here:
+
+* :class:`JsonlSink` — the structured-progress writer the exploration
+  driver (``explore.run(telemetry=...)``) and the soak tools emit
+  through: one JSON object per line (coverage bits, violations, corpus
+  size, dispatch wall per generation), machine-greppable where the old
+  ``log=print`` lines were prose.
+* :func:`explain` — the story the search banner only gestures at: for
+  one ``(seed, plan)`` repro key it re-runs the schedule with the
+  timeline ring, fleet metrics and history recording on, then
+  interleaves the dispatched-event stream, the injected fault plan, the
+  recorded operation history and the checker verdict into a readable
+  account of what the seed actually did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+import jax
+
+from ..engine.core import (
+    HALT_DONE,
+    HALT_IDLE,
+    HALT_RUNNING,
+    HALT_TIME_LIMIT,
+    MET_HALT_CODE,
+    METRIC_NAMES,
+    make_init,
+    make_run_while,
+)
+from .timeline import decode_timeline
+
+__all__ = ["JsonlSink", "explain"]
+
+
+class JsonlSink:
+    """Append-mode JSONL writer usable as an ``explore.run`` telemetry
+    callable: ``sink(record_dict)`` writes one line and flushes (a
+    killed campaign keeps every completed generation's record).
+    """
+
+    def __init__(self, path_or_file):
+        if hasattr(path_or_file, "write"):
+            self._fh = path_or_file
+            self._own = False
+        else:
+            self._fh = open(path_or_file, "a")
+            self._own = True
+
+    def __call__(self, record: dict) -> None:
+        self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._own:
+            self._fh.close()
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_HALT_STORY = {
+    HALT_RUNNING: "still running when the step budget ended",
+    HALT_DONE: "halted: the workload completed its scenario",
+    HALT_TIME_LIMIT: "halted: the configured time limit tripped",
+    HALT_IDLE: "deadlocked: the event pool ran empty with the seed "
+               "unhalted (nothing pending, nothing ever will be)",
+}
+
+# history `ok` convention (check.history): -1 invoke, 1 ok, 0 failed
+_OK_STORY = {-1: "invoke", 1: "ok", 0: "failed"}
+
+
+def _plan_rows_for(plan, seed):
+    """Compile whatever plan form the caller holds into one-seed rows."""
+    from ..chaos.plan import LiteralPlan, stack_plan_rows
+
+    if isinstance(plan, LiteralPlan):
+        return stack_plan_rows([plan]), plan.slots, plan.uses_dup(), plan
+    # a FaultPlan space: literalize for the exact trajectory + pretty
+    # printing, then compile the literal (identical rows by contract)
+    lit = plan.literalize(int(seed))
+    return stack_plan_rows([lit]), lit.slots, lit.uses_dup(), lit
+
+
+def explain(
+    wl,
+    cfg,
+    seed: int,
+    plan=None,
+    invariant=None,
+    history_invariant=None,
+    max_steps: int = 1000,
+    timeline_cap: int = 1024,
+    layout: str | None = None,
+    max_events: int = 200,
+) -> str:
+    """Narrate one ``(seed, plan)`` run: timeline + history + verdict.
+
+    ``plan`` is a chaos ``LiteralPlan`` (a corpus entry's exact form) or
+    ``FaultPlan`` (literalized for this seed), or None for a plain
+    seeded run. ``invariant`` / ``history_invariant`` follow the
+    ``search_seeds`` contract and become the verdict lines; without
+    either the narrative reports the run without judging it.
+    ``max_events`` bounds the printed timeline (the middle is elided;
+    the head establishes context, the tail holds the crash site).
+    """
+    seeds = np.asarray([seed], np.uint64)
+    if plan is not None:
+        rows, slots, dup, lit = _plan_rows_for(plan, seed)
+    else:
+        rows, slots, dup, lit = None, 0, False, None
+    init = make_init(
+        wl, cfg, plan_slots=slots, metrics=True, timeline_cap=timeline_cap
+    )
+    run = jax.jit(make_run_while(
+        wl, cfg, max_steps, layout=layout, dup_rows=dup,
+        metrics=True, timeline_cap=timeline_cap,
+    ))
+    state = init(seeds, rows) if rows is not None else init(seeds)
+    out = jax.block_until_ready(run(state))
+    view = {
+        f.name: np.asarray(getattr(out, f.name))
+        for f in dataclasses.fields(out)
+    }
+
+    lines = [
+        f"=== explain: {wl.name!r} seed {int(seed)} "
+        f"config_hash={cfg.hash()}"
+        + (f" plan_hash={lit.hash()}" if lit is not None else ""),
+    ]
+    if lit is not None:
+        lines.append("--- injected fault plan:")
+        mask = lit._mask()
+        for e, on in zip(lit.events, mask):
+            if on:
+                lines.append(f"    {e}")
+
+    # merge the dispatched-event stream with the history records by
+    # time; records carry an indented `*` marker under their dispatch
+    events = decode_timeline(view, wl, 0)
+    hist_n = int(view["hist_count"][0]) if view["hist_word"].shape[1] else 0
+    hist = [
+        (
+            int(view["hist_t"][0][i]),
+            tuple(int(x) for x in view["hist_word"][0][i]),
+        )
+        for i in range(hist_n)
+    ]
+    merged = []
+    hi = 0
+    for e in events:
+        merged.append(("ev", e))
+        while hi < len(hist) and hist[hi][0] <= e.time_ns:
+            merged.append(("rec", hist[hi]))
+            hi += 1
+    merged.extend(("rec", h) for h in hist[hi:])
+
+    lines.append(
+        f"--- timeline ({len(events)} dispatched events, "
+        f"{hist_n} history records"
+        + (f", {int(view['tl_drop'][0])} DROPPED at ring capacity"
+           if int(view["tl_drop"][0]) else "")
+        + "):"
+    )
+    shown = merged
+    if len(merged) > max_events:
+        head = max_events // 3
+        tail = max_events - head
+        shown = (
+            merged[:head]
+            + [("gap", len(merged) - max_events)]
+            + merged[-tail:]
+        )
+    for tag, item in shown:
+        if tag == "gap":
+            lines.append(f"    ... {item} rows elided ...")
+        elif tag == "ev":
+            e = item
+            origin = "timer" if e.src < 0 else f"node{e.src}"
+            argstr = ",".join(str(a) for a in e.args)
+            lines.append(
+                f"  [{e.time_ns / 1e6:>10.3f}ms] node{e.node} <- "
+                f"{e.kind_name(wl)}({argstr}) from {origin}"
+            )
+        else:
+            t, (op, key, arg, client, ok) = item
+            lines.append(
+                f"  [{t / 1e6:>10.3f}ms]   * history: op{op} key={key} "
+                f"arg={arg} client=n{client} "
+                f"{_OK_STORY.get(ok, f'ok={ok}')}"
+            )
+
+    met = view["met"][0]
+    code = int(met[MET_HALT_CODE])
+    lines.append(f"--- outcome: {_HALT_STORY.get(code, f'halt code {code}')}")
+    lines.append(
+        "    "
+        + ", ".join(
+            f"{name}={int(met[m])}"
+            for m, name in enumerate(METRIC_NAMES)
+            if name != "halt_code" and int(met[m])
+        )
+    )
+    if int(view["overflow"][0]):
+        lines.append(
+            f"    WARNING: {int(view['overflow'][0])} event(s) dropped to "
+            f"pool overflow — this run's evidence is unreliable"
+        )
+    if view["hist_word"].shape[1] and int(view["hist_drop"][0]):
+        lines.append(
+            f"    WARNING: {int(view['hist_drop'][0])} history record(s) "
+            f"dropped — checker verdicts are void for this seed"
+        )
+
+    verdicts = []
+    if invariant is not None:
+        ok = bool(np.asarray(invariant(view))[0])
+        verdicts.append(("final-state invariant", ok))
+    if history_invariant is not None:
+        from ..check.history import BatchHistory
+
+        hok = bool(np.asarray(history_invariant(BatchHistory.from_view(view)))[0])
+        verdicts.append(("history invariant", hok))
+    for what, ok in verdicts:
+        verdict = "HOLDS" if ok else "VIOLATED"
+        lines.append(f"--- verdict: {what} {verdict}")
+    if not verdicts:
+        lines.append("--- verdict: no invariant supplied (narrative only)")
+    lines.append(
+        f"--- repro: seed={int(seed)} config_hash={cfg.hash()}"
+        + (f" plan_hash={lit.hash()}" if lit is not None else "")
+        + f" trace={int(view['trace'][0]):#018x}"
+    )
+    return "\n".join(lines)

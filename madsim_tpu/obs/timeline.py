@@ -1,0 +1,97 @@
+"""Per-seed timeline decode: the captured event ring as readable events.
+
+The engine's timeline ring (engine/core.py, ``timeline_cap=T``) records
+the dispatched-event stream — exactly the (time, kind, node, src, args)
+tuples the trace hash folds — as fixed-size per-seed columns. This
+module decodes one seed's ring host-side against the workload's kind
+table into the same :class:`~madsim_tpu.engine.replay.ReplayEvent` rows
+the C++-oracle replay produces, so everything downstream (text
+timelines via ``engine.replay.format_timeline``, Perfetto export via
+``obs.to_perfetto``, the ``obs.explain`` narrative) is shared between
+the two capture paths.
+
+``refold`` recomputes the certified trace hash from a decoded timeline
+(payload words are captured too): the test gate proving the captured
+story and the bit-identical evidence are the same events — the
+engine.replay refold contract, now available without the oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.core import Workload
+from ..engine.replay import ReplayEvent
+from ..engine.replay import refold as _replay_refold
+
+__all__ = ["decode_timeline", "refold_timeline", "timeline_counts"]
+
+
+def _get(view, name: str):
+    """Field access across the shapes a timeline travels in: a
+    search_seeds view dict, a SearchReport.timeline namespace, or a raw
+    batched SimState."""
+    if isinstance(view, dict):
+        return view[name]
+    return getattr(view, name)
+
+
+def timeline_counts(view) -> tuple:
+    """(tl_count, tl_drop) numpy arrays over the seed axis."""
+    return (
+        np.asarray(_get(view, "tl_count")),
+        np.asarray(_get(view, "tl_drop")),
+    )
+
+
+def decode_timeline(view, wl: Workload | None = None, seed: int = 0) -> list:
+    """Decode seed-row ``seed``'s captured ring into ReplayEvent rows.
+
+    ``view`` is anything carrying the ``tl_*`` columns with a leading
+    seed axis: the final batched ``SimState``, a ``search_seeds`` state
+    view, or ``SearchReport.timeline``. ``wl`` is only consulted for
+    arg width (rows keep the captured width without it).
+    """
+    count = int(np.asarray(_get(view, "tl_count"))[seed])
+    t = np.asarray(_get(view, "tl_t"))[seed]
+    meta = np.asarray(_get(view, "tl_meta"))[seed].astype(np.uint32)
+    args = np.asarray(_get(view, "tl_args"))[seed]
+    pay = np.asarray(_get(view, "tl_pay"))[seed]
+    if t.shape[0] == 0:
+        raise ValueError(
+            "state carries no timeline columns — run with timeline_cap > 0"
+        )
+    events = []
+    for i in range(count):
+        m = int(meta[i])
+        events.append(
+            ReplayEvent(
+                time_ns=int(t[i]),
+                kind=m & 0xFF,
+                node=((m >> 8) & 0xFF) - 1,
+                src=((m >> 16) & 0xFF) - 1,
+                args=tuple(int(x) for x in args[i]),
+                pay=tuple(int(x) for x in pay[i]),
+            )
+        )
+    return events
+
+
+def refold_timeline(events, wl: Workload) -> int:
+    """Recompute the trace hash from a decoded timeline.
+
+    Must equal the run's ``SimState.trace`` for the same seed whenever
+    the ring did not overflow (``tl_drop == 0`` — a truncated stream
+    can only refold a prefix). The ring captures payload words, so the
+    certificate covers payload workloads (kvchaos, raftlog) too.
+    """
+    # the replay refold reads four arg words; pad captured rows (the
+    # engine folds only args_words, missing high words are zero)
+    padded = [
+        ReplayEvent(
+            time_ns=e.time_ns, kind=e.kind, node=e.node, src=e.src,
+            args=tuple(e.args) + (0,) * (4 - len(e.args)), pay=e.pay,
+        )
+        for e in events
+    ]
+    return _replay_refold(padded, wl)

@@ -44,6 +44,7 @@ _SM_NOCHECK = (
 __all__ = [
     "make_mesh",
     "merge_coverage",
+    "merge_metrics",
     "seed_sharding",
     "shard_state",
     "shard_over_seeds",
@@ -133,6 +134,43 @@ def merge_coverage(bitmaps, mesh: Mesh | None = None) -> np.ndarray:
                    **_SM_NOCHECK)
     )(bm)
     return np.bitwise_or.reduce(np.asarray(per_dev, np.uint32), axis=0)
+
+
+def merge_metrics(met, mesh: Mesh | None = None) -> np.ndarray:
+    """Sum-fold per-seed fleet-metric columns (S, M) into (M,) totals.
+
+    The metrics analog of :func:`merge_coverage`: with a ``mesh``, each
+    device sums its local seed shard (``shard_map``, zero cross-device
+    traffic) and only device-count rows reach the host — a 65k-seed
+    sweep's fleet totals cost D*M words of transfer. int64 accumulation
+    so 32-bit per-seed counters can't overflow the fleet sum. The
+    MET_HALT_CODE slot is summed like any other (meaningless as a
+    total); use ``obs.fleet_reduce`` when the halt-code distribution or
+    histograms are wanted.
+    """
+    import jax.numpy as jnp
+
+    mm = jnp.asarray(met)
+    if mm.ndim != 2:
+        raise ValueError(f"met must be (S, M), got shape {mm.shape}")
+
+    def fold(m):
+        return jnp.sum(m.astype(jnp.int64), axis=0)
+
+    if mesh is None:
+        return np.asarray(jax.jit(fold)(mm))
+    n_dev = mesh.devices.size
+    if mm.shape[0] % n_dev:
+        raise ValueError(
+            f"{mm.shape[0]} metric rows do not split over {n_dev} devices"
+        )
+    spec = P(mesh.axis_names)
+    local = lambda m: fold(m)[None, :]  # noqa: E731 — (1, M) per device
+    per_dev = jax.jit(
+        _shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+                   **_SM_NOCHECK)
+    )(mm)
+    return np.asarray(per_dev, np.int64).sum(axis=0)
 
 
 def shard_run_compacted(

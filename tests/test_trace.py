@@ -58,6 +58,76 @@ def test_same_seed_logs_identically():
     assert _capture(3) != _capture(4)
 
 
+def _capture_interleaved(seed):
+    """Two concurrent tasks with nested spans, interleaved across await
+    points — the span() docstring's per-task claim under task switches."""
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    sink = Sink()
+    sink.setFormatter(ms.SimFormatter())
+    sink.addFilter(ms.SimContextFilter())
+    log = logging.getLogger("test_trace_nest")
+    log.setLevel(logging.INFO)
+    log.addHandler(sink)
+    try:
+        async def main():
+            h = ms.Handle.current()
+            node = h.create_node().name("srv").build()
+
+            async def worker(tag, delay):
+                with ms.span(f"outer-{tag}"):
+                    log.info("enter %s", tag)
+                    await ms.sleep(delay)  # the other task runs here
+                    with ms.span(f"inner-{tag}"):
+                        log.info("deep %s", tag)
+                        await ms.sleep(delay)
+                        log.info("deep2 %s", tag)
+                    log.info("shallow %s", tag)
+                log.info("exit %s", tag)
+
+            t1 = node.spawn(worker("a", 0.3))
+            t2 = node.spawn(worker("b", 0.2))
+            await t1
+            await t2
+
+        rt = ms.Runtime(seed=seed)
+        rt.set_time_limit(30)
+        rt.block_on(main())
+    finally:
+        log.removeHandler(sink)
+    return records
+
+
+def test_span_nesting_survives_task_switches():
+    """Span stacks are per task: interleaved awaits never leak one
+    task's spans into the other's records, and nesting pops in order."""
+    recs = _capture_interleaved(5)
+    for r in recs:
+        for tag, other in (("a", "b"), ("b", "a")):
+            if f"enter {tag}" in r or f"shallow {tag}" in r:
+                assert f"outer-{tag}" in r and f"inner-{tag}" not in r
+                assert f"-{other}" not in r  # no cross-task leak
+            if f"deep {tag}" in r or f"deep2 {tag}" in r:
+                assert f"outer-{tag}:inner-{tag}" in r
+                assert f"-{other}" not in r
+            if f"exit {tag}" in r:
+                assert "outer-" not in r and "inner-" not in r
+
+
+def test_interleaved_same_seed_logs_byte_identical():
+    """The docstring's determinism claim under real concurrency: two
+    same-seed runs of interleaving span-carrying tasks produce
+    byte-identical logs; a different seed does not."""
+    a, b = _capture_interleaved(9), _capture_interleaved(9)
+    assert len(a) == 10  # 5 records per worker
+    assert a == b
+    assert _capture_interleaved(10) != a  # seeded timestamps differ
+
+
 def test_no_context_outside_sim():
     records = []
 

@@ -24,7 +24,8 @@ SHELL   := /bin/bash
 # bash, not sh: the tier1 recipe uses `set -o pipefail`/PIPESTATUS
 
 .PHONY: check check-full native test test-full tier1 determinism \
-        bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak clean
+        bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
+        store-soak clean
 
 check: native test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -89,6 +90,16 @@ explore:
 OBS_SEEDS ?= 8192
 obs-soak:
 	$(PY) tools/obs_soak.py $(OBS_SEEDS)
+
+# Storage-fault soak (madsim_tpu disk chaos): disk-faults-off identity
+# (layouts + compact + oracle sample), fsync-before-reply raftlog clean
+# under crash/partition/torn-write chaos, the lying-fsync positive
+# control for check.recovery_safety, and the missing-sync mutant caught
+# by the DiskFault-grown guided hunt + shrunk + replayed. 2048 is the
+# evidence-artifact scale (STORE_r10.txt). Needs the native oracle.
+STORE_SEEDS ?= 2048
+store-soak: native
+	$(PY) tools/store_soak.py $(STORE_SEEDS)
 
 # Session-start TPU capture: the TPU tunnel historically wedges
 # mid-session, so grab the round's accelerator numbers FIRST (same

@@ -8,7 +8,9 @@ is a layer:
 * **FaultPlan** (chaos/plan.py) — a declarative spec of composable fault
   generators (crash-restart storms, pause storms, symmetric/asymmetric/
   partial partitions, gray-failure slow links, message duplication,
-  clock skew). Compilation draws counter-based threefry randomness
+  clock skew, and DiskFault storage chaos: torn-write and sync-lie
+  windows for sync-discipline workloads). Compilation draws counter-based
+  threefry randomness
   keyed ``(seed, plan-slot)``, so each seed gets a distinct, exactly
   reproducible fault trajectory and the whole seed batch compiles in
   one vectorized pass.
@@ -29,6 +31,7 @@ is a layer:
 from .plan import (  # noqa: F401
     ClockSkew,
     CrashStorm,
+    DiskFault,
     Duplicate,
     FaultEvent,
     FaultPlan,
@@ -47,6 +50,7 @@ from .shrink import ShrinkResult, shrink_plan  # noqa: F401
 __all__ = [
     "ClockSkew",
     "CrashStorm",
+    "DiskFault",
     "Duplicate",
     "FaultEvent",
     "FaultPlan",

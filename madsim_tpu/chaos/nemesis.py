@@ -33,6 +33,10 @@ from ..engine.core import (
     KIND_RESUME,
     KIND_SKEW,
     KIND_SLOW_LINK,
+    KIND_SYNC_LOSS,
+    KIND_SYNC_OK,
+    KIND_TORN_OFF,
+    KIND_TORN_ON,
     KIND_UNCLOG,
     KIND_UNCLOG_1W,
     KIND_UNCLOG_NODE,
@@ -81,6 +85,20 @@ class Nemesis:
             )
         return ids[i]
 
+    def _targets(self, handle, i: int) -> list:
+        """Resolve a fault target to runtime node ids: the disk-fault
+        kinds allow ``-1`` = every node (engine/core.py 251-254), which
+        must broadcast here too — Python negative indexing through
+        ``_node`` would silently hit only the LAST created node and
+        break dual-mode parity."""
+        if i >= 0:
+            return [self._node(handle, i)]
+        if self._nodes is not None:
+            return [n if isinstance(n, int) else n.id for n in self._nodes]
+        from ..runtime.task import MAIN_NODE_ID
+
+        return sorted(n for n in handle.executor.nodes if n != MAIN_NODE_ID)
+
     def events(self) -> list[FaultEvent]:
         """The concrete trajectory this nemesis will apply, time order."""
         handle = self._resolve_handle()
@@ -102,8 +120,11 @@ class Nemesis:
         from ..net.netsim import NetSim
 
         netsim = handle.simulator(NetSim)
+        # dup toggles carry no node; disk-fault kinds resolve their own
+        # targets (a0 may be -1 = every node)
         a = self._node(handle, ev.a0) if ev.kind not in (
-            KIND_DUP_ON, KIND_DUP_OFF
+            KIND_DUP_ON, KIND_DUP_OFF, KIND_SYNC_LOSS, KIND_SYNC_OK,
+            KIND_TORN_ON, KIND_TORN_OFF,
         ) else 0
         if ev.kind == KIND_KILL:
             handle.kill(a)
@@ -140,5 +161,19 @@ class Nemesis:
             netsim.set_duplicate(False)
         elif ev.kind == KIND_SKEW:
             handle.set_clock_skew(a, ev.a1)
+        elif ev.kind in (KIND_SYNC_LOSS, KIND_SYNC_OK):
+            # storage faults land on FsSim — the dual of the engine's
+            # sync-discipline state (fs.py injectable-fault hooks)
+            from ..fs import FsSim
+
+            sim = handle.simulator(FsSim)
+            for nid in self._targets(handle, ev.a0):
+                sim.set_sync_loss(nid, ev.kind == KIND_SYNC_LOSS)
+        elif ev.kind in (KIND_TORN_ON, KIND_TORN_OFF):
+            from ..fs import FsSim
+
+            sim = handle.simulator(FsSim)
+            for nid in self._targets(handle, ev.a0):
+                sim.set_torn(nid, ev.kind == KIND_TORN_ON)
         else:
             raise ValueError(f"nemesis cannot apply kind {ev.kind}")

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import warnings
 
 import numpy as np
 
@@ -46,6 +47,10 @@ from ..engine.core import (
     KIND_RESUME,
     KIND_SKEW,
     KIND_SLOW_LINK,
+    KIND_SYNC_LOSS,
+    KIND_SYNC_OK,
+    KIND_TORN_OFF,
+    KIND_TORN_ON,
     KIND_UNCLOG,
     KIND_UNCLOG_1W,
     KIND_UNSLOW,
@@ -72,6 +77,7 @@ __all__ = [
     "GrayFailure",
     "Duplicate",
     "ClockSkew",
+    "DiskFault",
     "kind_name",
     "stack_plan_rows",
 ]
@@ -90,6 +96,10 @@ _KIND_NAMES = {
     KIND_DUP_ON: "dup-on",
     KIND_DUP_OFF: "dup-off",
     KIND_SKEW: "skew",
+    KIND_SYNC_LOSS: "sync-loss",
+    KIND_SYNC_OK: "sync-ok",
+    KIND_TORN_ON: "torn-on",
+    KIND_TORN_OFF: "torn-off",
 }
 
 
@@ -674,6 +684,82 @@ class ClockSkew:
 
 
 
+@dataclasses.dataclass(frozen=True)
+class DiskFault:
+    """Storage chaos for ``Workload.durable_sync`` workloads: the
+    FoundationDB/sled disk-fault repertoire as composable windows.
+
+    ``n_torn`` torn-write windows arm a random target node's torn-write
+    mode for a random duration — a KILL landing inside the window
+    persists only a drawn *prefix* of the node's last uncommitted
+    durable write (the power-failure tear). ``n_sync_loss`` sync-lie
+    windows make the node's disk silently drop sync commits — the
+    firmware-lies-about-fsync fault; note a lying disk breaks the
+    assumptions raft-class protocols are allowed to make, so clean-model
+    certificates run torn-only windows and use sync-loss as the
+    positive control for the recovery-safety detector. On workloads
+    without the sync discipline every window is a no-op (the identity-
+    defaults rule of the other extended kinds)."""
+
+    targets: tuple
+    n_torn: int = 1
+    n_sync_loss: int = 0
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    dur_min_ns: int = 50_000_000
+    dur_max_ns: int = 400_000_000
+
+    def __post_init__(self):
+        if not self.targets:
+            raise ValueError("DiskFault needs at least one target node")
+        if self.n_torn < 0 or self.n_sync_loss < 0:
+            raise ValueError("window counts must be >= 0")
+        if self.n_torn + self.n_sync_loss < 1:
+            raise ValueError(
+                "DiskFault needs at least one torn or sync-loss window"
+            )
+        _check_window(self.t_min_ns, self.t_max_ns, "disk-fault-time")
+        _check_window(self.dur_min_ns, self.dur_max_ns, "disk-fault-duration")
+
+    @property
+    def slots(self) -> int:
+        return 2 * (self.n_torn + self.n_sync_loss)
+
+    def _windows(self):
+        """(on-kind, off-kind) per window, torn windows first — the
+        spec-offset rule: growing n_sync_loss never re-randomizes the
+        torn windows before it."""
+        return [(KIND_TORN_ON, KIND_TORN_OFF)] * self.n_torn + [
+            (KIND_SYNC_LOSS, KIND_SYNC_OK)
+        ] * self.n_sync_loss
+
+    def compile_batch(self, seeds, slot: int, xp=np):
+        st = _Stream(seeds, slot, xp)
+        rows = []
+        for i, (k_on, k_off) in enumerate(self._windows()):
+            who = st.pick(self.targets, 3 * i)
+            at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
+            dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 3 * i + 2)
+            rows.append((at, k_on, who, 0, True))
+            rows.append((at + dur, k_off, who, 0, True))
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        out = []
+        for k_on, k_off in self._windows():
+            out.append(SlotTemplate(
+                kind=k_on, t_min_ns=self.t_min_ns, t_max_ns=self.t_max_ns,
+                targets=self.targets,
+            ))
+            out.append(SlotTemplate(
+                kind=k_off,
+                t_min_ns=self.t_min_ns + self.dur_min_ns,
+                t_max_ns=self.t_max_ns + self.dur_max_ns,
+                targets=self.targets,
+            ))
+        return tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # plans
 # ---------------------------------------------------------------------------
@@ -750,6 +836,61 @@ class FaultPlan(_PlanBase):
         spec tuple fully determines every compiled trajectory."""
         return hashlib.sha256(repr(self.specs).encode()).hexdigest()[:16]
 
+    def validate_windows(self, time_limit_ns: int, warn: bool = True):
+        """Specs whose fire window opens at-or-after ``time_limit_ns``.
+
+        The default CrashStorm/PauseStorm windows (20-400 ms) were tuned
+        for long chaos runs; a short workload (raft halts its scenario
+        in ~200-300 ms, or ``cfg.time_limit_ns`` caps the clock) can
+        halt before a late window ever opens, silently turning the storm
+        into a no-op — the sweep then certifies the UNFAULTED protocol.
+        ``search_seeds`` calls this automatically when the config sets a
+        time limit; ``warn=True`` (default) emits one UserWarning naming
+        the dead specs. Returns the offending spec list (empty = fine).
+        Use :meth:`clamped` to shrink the windows instead.
+        """
+        late = [
+            s
+            for s in self.specs
+            if getattr(s, "t_min_ns", None) is not None
+            and s.t_min_ns >= time_limit_ns
+        ]
+        if late and warn:
+            names = ", ".join(
+                f"{type(s).__name__}(t_min_ns={s.t_min_ns})" for s in late
+            )
+            warnings.warn(
+                f"fault plan {self.name!r}: {names} cannot fire before "
+                f"the {time_limit_ns} ns time limit — the run will see "
+                f"no such fault (shrink the window, or use "
+                f"plan.clamped(time_limit_ns))",
+                UserWarning,
+                stacklevel=3,
+            )
+        return late
+
+    def clamped(self, time_limit_ns: int) -> "FaultPlan":
+        """A copy with every spec's fire window intersected with
+        ``[0, time_limit_ns)`` — the warn-or-clamp companion of
+        :meth:`validate_windows`. Durations are untouched (a fault may
+        legitimately heal after the limit); specs without a time window
+        pass through. NOTE: clamping changes the spec tuple, so the
+        plan hash (and every compiled trajectory) changes with it."""
+        if time_limit_ns <= 0:
+            raise ValueError(f"time_limit_ns must be > 0, got {time_limit_ns}")
+        specs = []
+        for s in self.specs:
+            t_min = getattr(s, "t_min_ns", None)
+            t_max = getattr(s, "t_max_ns", None)
+            if t_min is None or t_max is None:
+                specs.append(s)
+                continue
+            new_min = min(t_min, max(time_limit_ns - 1, 0))
+            new_max = max(min(t_max, time_limit_ns), new_min)
+            specs.append(
+                dataclasses.replace(s, t_min_ns=new_min, t_max_ns=new_max)
+            )
+        return dataclasses.replace(self, specs=tuple(specs))
 
     def compile_batch(self, seeds, wl=None, device: bool = False) -> PlanRows:
         """Compile the whole seed batch to engine pool rows (S, slots).

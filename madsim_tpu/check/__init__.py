@@ -48,6 +48,7 @@ from .vectorized import (  # noqa: F401
     monotonic_reads,
     monotonic_reads_strict,
     read_your_writes,
+    recovery_safety,
     stale_reads,
 )
 
@@ -74,5 +75,6 @@ __all__ = [
     "monotonic_reads",
     "monotonic_reads_strict",
     "read_your_writes",
+    "recovery_safety",
     "stale_reads",
 ]

@@ -49,6 +49,7 @@ __all__ = [
     "read_your_writes",
     "stale_reads",
     "election_safety",
+    "recovery_safety",
 ]
 
 _MIN = np.int64(-(2**62))  # "no prior write" floor sentinel
@@ -198,6 +199,52 @@ def stale_reads(
     authority for the key, a flagged seed means a committed write's
     effect vanished — the lost-write detector."""
     return _read_floor_violations(h, read_op, write_op, own_writes_only=False)
+
+
+def recovery_safety(
+    h: BatchHistory, sync_op: int, recover_op: int
+) -> np.ndarray:
+    """Crash-recovery safety: a restarted node never regresses durably
+    synced state.
+
+    The workload records a successful ``sync_op`` event whenever a sync
+    COMMITS a state change (arg = the new durable value, e.g. a log
+    length — raftlog's ``OP_SYNCED``) and a ``recover_op`` event when a
+    restarted node comes back up (arg = the value it recovered —
+    ``OP_RECOVER``). A seed is flagged when any recover's arg is below
+    the arg of the SAME client's (node's) latest earlier sync record.
+
+    The floor is the LAST sync, not the running max: a newer-term
+    leader may legitimately truncate a follower's log, and the
+    truncated-then-synced length is exactly what a crash must recover
+    to. Under correct fsync placement this holds even through torn-
+    write faults (a tear only loses *uncommitted* bytes); a lying disk
+    (chaos ``SYNC_LOSS`` windows) violates it by design — the detector
+    doubles as the positive control that the fault injection works.
+    Buffer order is dispatch order (the engine appends at dispatch), so
+    "earlier" needs no timestamps.
+    """
+    valid, op, key, arg, client, ok = _cols(h)
+    s_dim, h_dim = valid.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    sync_m = valid & (op == sync_op) & (ok == OK_OK)
+    rec_m = valid & (op == recover_op) & (ok == OK_OK)
+    viol = np.zeros(s_dim, bool)
+    if not rec_m.any() or not sync_m.any():
+        return ~viol
+    idx_row = np.broadcast_to(np.arange(h_dim)[None, :], valid.shape)
+    for c in np.unique(client[rec_m]):
+        sm = sync_m & (client == c)
+        # index of the latest sync at-or-before each buffer slot
+        # (running max over marked indices; -1 = no sync yet)
+        last = np.maximum.accumulate(np.where(sm, idx_row, -1), axis=1)
+        floor = np.take_along_axis(
+            np.where(sm, arg, 0), np.maximum(last, 0), axis=1
+        )
+        rm = rec_m & (client == c)
+        viol |= (rm & (last >= 0) & (arg < floor)).any(axis=1)
+    return ~viol
 
 
 def election_safety(h: BatchHistory, elect_op: int) -> np.ndarray:

@@ -33,6 +33,10 @@ from .core import (  # noqa: E402,F401
     KIND_RESUME,
     KIND_SKEW,
     KIND_SLOW_LINK,
+    KIND_SYNC_LOSS,
+    KIND_SYNC_OK,
+    KIND_TORN_OFF,
+    KIND_TORN_ON,
     KIND_UNCLOG,
     KIND_UNCLOG_1W,
     KIND_UNCLOG_NODE,

@@ -32,10 +32,11 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # (hist_word/hist_t/hist_count/hist_drop, madsim_tpu.check); format 4:
 # extended chaos state (slow/dup/skew, madsim_tpu.chaos); format 5:
 # coverage fingerprint (cov/cov_last, madsim_tpu.explore); format 6:
-# observability columns (cov_hits/met/tl_*, madsim_tpu.obs). Older
-# checkpoints are rejected with the designed mismatch error rather
-# than a KeyError mid-load
-_FORMAT = 6
+# observability columns (cov_hits/met/tl_*, madsim_tpu.obs); format 7:
+# storage sync-discipline columns (disk/wmask/sync_loss/torn,
+# madsim_tpu.chaos disk faults). Older checkpoints are rejected with
+# the designed mismatch error rather than a KeyError mid-load
+_FORMAT = 7
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

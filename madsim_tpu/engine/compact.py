@@ -71,6 +71,10 @@ RESULT_FIELDS = (
     "overflow",
     "msg_count",
     "node_state",
+    # synced durable image (Workload.durable_sync): zero-size when the
+    # sync discipline is off; banked so recovery-state invariants can
+    # compare buffered vs committed durable columns on compacted runs
+    "disk",
     "hist_count",
     "hist_drop",
     "hist_word",

@@ -65,6 +65,7 @@ from .rng import (
     PURPOSE_LATENCY,
     PURPOSE_LOSS,
     PURPOSE_POLL_COST,
+    PURPOSE_TORN,
     Draw,
     chance_threshold,
 )
@@ -104,6 +105,10 @@ __all__ = [
     "KIND_SKEW",
     "KIND_CLOG_1W",
     "KIND_UNCLOG_1W",
+    "KIND_SYNC_LOSS",
+    "KIND_SYNC_OK",
+    "KIND_TORN_ON",
+    "KIND_TORN_OFF",
     "pack_slow_arg",
     "unpack_slow_arg",
     "user_kind",
@@ -210,6 +215,17 @@ KIND_SKEW = 248  # args[0]=node args[1]=skew_ns: the node's clock reads
 #                  now+skew (what its handlers observe as ctx.now)
 KIND_CLOG_1W = 249  # args[0]=src args[1]=dst — asymmetric partition edge
 KIND_UNCLOG_1W = 250
+# disk-fault kinds (madsim_tpu.chaos DiskFault; only meaningful for
+# Workload.durable_sync workloads — a no-op otherwise, like DUP_ON
+# without dup_rows). args[0] = target node, -1 = every node.
+KIND_SYNC_LOSS = 251  # the node's disk starts LYING: sync commits are
+#                       silently dropped (the committed bit never sets)
+KIND_SYNC_OK = 252  # end of the sync-lie window: syncs commit again
+KIND_TORN_ON = 253  # arm torn-write mode: the next KILL persists only a
+#                     threefry-drawn PREFIX of the last uncommitted
+#                     durable write (PURPOSE_TORN) on top of the synced
+#                     image — the FDB/sled power-failure fault
+KIND_TORN_OFF = 254
 
 
 # ---------------------------------------------------------------------------
@@ -237,11 +253,21 @@ MET_TIMER = 9  # user timer fires (non-message user dispatches)
 MET_RECORD = 10  # history records appended
 MET_RNG = 11  # threefry blocks drawn while the seed was active
 MET_HALT_CODE = 12  # not a counter: HALT_* code of how the seed stopped
-N_METRICS = 13
+# storage-fault counters (Workload.durable_sync; always 0 otherwise).
+# Appended after MET_HALT_CODE so every pre-existing slot id is stable.
+MET_SYNC = 13  # sync commits honored (EmitBuilder.sync, disk committed)
+MET_SYNC_LOST = 14  # syncs swallowed by a KIND_SYNC_LOSS lie window
+MET_TORN = 15  # kills that landed inside an armed torn-write window
+#                (whether bytes actually tore depends on an uncommitted
+#                write being outstanding — on a correct fsync-everywhere
+#                model nothing ever is, which is the theorem, so this
+#                counts the exercised windows, not the data damage)
+N_METRICS = 16
 
 METRIC_NAMES = (
     "sent", "delivered", "lost", "dead_drop", "dup", "crash", "restart",
     "pause", "clog_block", "timer", "record", "rng_blocks", "halt_code",
+    "sync", "sync_lost", "torn",
 )
 
 # MET_HALT_CODE values
@@ -349,6 +375,12 @@ class Emits:
     # and the dispatch time when appending to the history columns
     rec_valid: jnp.ndarray = None  # (R,) bool
     rec: jnp.ndarray = None  # (R,4) int32
+    # sync flag (Workload.durable_sync): True = the handler called
+    # fsync before returning — the engine commits the node's durable
+    # columns to its disk image at THIS dispatch (unless a SYNC_LOSS
+    # window makes the disk lie). A scalar, not per-slot: one dispatch
+    # is one fsync decision. Ignored when the discipline is off.
+    sync: jnp.ndarray = None  # () bool
 
     @staticmethod
     def none(k: int, w: int = 0, a: int = 4, r: int = 0) -> "Emits":
@@ -362,6 +394,7 @@ class Emits:
             pay=jnp.zeros((k, w), jnp.int32),
             rec_valid=jnp.zeros((r,), jnp.bool_),
             rec=jnp.zeros((r, 4), jnp.int32),
+            sync=jnp.asarray(False),
         )
 
 
@@ -379,6 +412,7 @@ class EmitBuilder:
         self._r = r
         self._recs: list[tuple] = []
         self._rows: list[tuple] = []
+        self._syncs: list = []
 
     def _push(self, send, kind, dst, delay, args, when, pay=()):
         if len(self._rows) >= self._k:
@@ -457,6 +491,36 @@ class EmitBuilder:
         """Set the node's clock skew: its handlers observe now+skew_ns."""
         self.after(0, KIND_SKEW, 0, (node, skew_ns), when)
 
+    def sync(self, when=True):
+        """fsync the handling node's durable columns (Workload.durable_sync).
+
+        Under the two-phase sync discipline a durable write lands in a
+        volatile buffer and survives KIND_KILL only once a sync has
+        committed it; calling this inside the dispatch that wrote models
+        a blocking fsync before the handler's messages go out. A no-op
+        when the workload does not opt into the discipline, and a LIE
+        inside a chaos ``KIND_SYNC_LOSS`` window (the commit silently
+        does not happen — what the hunt for missing-sync bugs injects).
+        """
+        self._syncs.append(when)
+
+    def sync_loss(self, node, when=True):
+        """Chaos: the node's disk starts lying — syncs stop committing
+        (node=-1: every node). See ``chaos.DiskFault`` for the plan form."""
+        self.after(0, KIND_SYNC_LOSS, 0, (node,), when)
+
+    def sync_ok(self, node, when=True):
+        """Chaos: end the node's sync-lie window."""
+        self.after(0, KIND_SYNC_OK, 0, (node,), when)
+
+    def torn_on(self, node, when=True):
+        """Chaos: arm torn-write mode — the node's next KILL persists
+        only a drawn prefix of its last uncommitted durable write."""
+        self.after(0, KIND_TORN_ON, 0, (node,), when)
+
+    def torn_off(self, node, when=True):
+        self.after(0, KIND_TORN_OFF, 0, (node,), when)
+
     def halt(self, when=True):
         self.after(0, KIND_HALT, 0, (), when)
 
@@ -499,12 +563,21 @@ class EmitBuilder:
             jnp.stack(rows + [jnp.zeros((4,), jnp.int32)] * pad),
         )
 
+    def _build_sync(self):
+        sync = jnp.asarray(False)
+        for wh in self._syncs:
+            sync = sync | jnp.asarray(wh, jnp.bool_)
+        return sync
+
     def build(self) -> Emits:
         k, w = self._k, self._w
         rec_valid, rec = self._build_recs()
+        sync = self._build_sync()
         if not self._rows:
             em = Emits.none(k, w, self._a)
-            return dataclasses.replace(em, rec_valid=rec_valid, rec=rec)
+            return dataclasses.replace(
+                em, rec_valid=rec_valid, rec=rec, sync=sync
+            )
         pad = k - len(self._rows)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_r) in self._rows]
         send = [jnp.asarray(s, jnp.bool_) for (_w, s, *_r) in self._rows]
@@ -534,6 +607,7 @@ class EmitBuilder:
             pay=jnp.stack(pay + [jnp.zeros((w,), jnp.int32)] * pad),
             rec_valid=rec_valid,
             rec=rec,
+            sync=sync,
         )
 
 
@@ -641,6 +715,18 @@ class Workload:
     # call EmitBuilder.record and the engine appends fixed-size history
     # rows per seed, checked host-side by the check package.
     history: HistorySpec | None = None
+    # two-phase sync discipline over durable_cols (the storage-chaos
+    # analog of a real write buffer, fs.rs:51 taken seriously): a
+    # durable write lands in the node's volatile buffer and survives
+    # KIND_KILL only once an EmitBuilder.sync commits it to the node's
+    # disk image; chaos SYNC_LOSS windows make syncs lie and TORN_ON
+    # makes a kill persist a drawn prefix of the last uncommitted
+    # write. False (default) keeps the historical all-or-nothing
+    # semantics: durable columns survive kill verbatim. NOTE: a
+    # workload that syncs every durable write in the same dispatch is
+    # trajectory-identical either way when no disk faults are injected
+    # (the revert is a no-op), which keeps oracle compares exact.
+    durable_sync: bool = False
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -667,6 +753,11 @@ class Workload:
                     f"durable_cols {bad} out of range for "
                     f"state_width={self.state_width}"
                 )
+        if self.durable_sync and not self.durable_cols:
+            raise ValueError(
+                "durable_sync needs durable_cols: the sync discipline "
+                "governs exactly the columns that survive a kill"
+            )
         if self.handler_names is not None and len(self.handler_names) != len(
             self.handlers
         ):
@@ -724,6 +815,17 @@ class SimState:
     slow: jnp.ndarray  # (N,N) int32 — per-link latency multiplier (1 = normal)
     dup: jnp.ndarray  # () bool — message duplication on
     skew: jnp.ndarray  # (N,) int32 — per-node clock skew, ns (ctx.now offset)
+    # two-phase sync discipline (Workload.durable_sync; D = n_nodes when
+    # on, else 0 — zero-size arrays, zero step cost, bit-identical
+    # values, the cov_words discipline). ``disk`` is the last-SYNCED
+    # image of each node's durable columns (volatile columns unused);
+    # a KILL reverts the node's durable state to it. ``wmask`` marks
+    # the columns of the node's most recent uncommitted durable write —
+    # the write a TORN_ON kill tears (a drawn prefix persists).
+    disk: jnp.ndarray  # (D,U) int32 synced durable image
+    wmask: jnp.ndarray  # (D,U) bool last uncommitted durable write's columns
+    sync_loss: jnp.ndarray  # (D,) bool — sync-lie window active (chaos)
+    torn: jnp.ndarray  # (D,) bool — torn-write mode armed (chaos)
     # operation history (madsim_tpu.check), H = HistorySpec.capacity
     # (0 when Workload.history is None). Rows are append-ordered by
     # dispatch time; hist_drop counts records lost to a full buffer —
@@ -899,6 +1001,9 @@ def make_init(
     h = wl.history.capacity if wl.history is not None else 0
     tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
     base_state = jnp.asarray(wl.initial_state())
+    # sync discipline: a fresh node's disk holds the initial image (the
+    # durable columns of init_state are what a cold start reads back)
+    d = n if wl.durable_sync else 0
 
     def init_one(seed, pt=None, pk=None, pa=None, pv=None) -> SimState:
         seed = jnp.asarray(seed, jnp.uint64)
@@ -948,6 +1053,10 @@ def make_init(
             slow=jnp.ones((n, n), jnp.int32),
             dup=jnp.asarray(False),
             skew=jnp.zeros((n,), jnp.int32),
+            disk=(base_state if d else jnp.zeros((0, u), jnp.int32)),
+            wmask=jnp.zeros((d, u), jnp.bool_),
+            sync_loss=jnp.zeros((d,), jnp.bool_),
+            torn=jnp.zeros((d,), jnp.bool_),
             hist_count=jnp.int32(0),
             hist_drop=jnp.int32(0),
             hist_word=jnp.zeros((h, 5), jnp.int32),
@@ -1104,6 +1213,10 @@ def make_step(
     # durable columns survive kill/restart (FsSim power-fail analog);
     # static per workload, so the select compiles to a constant mask
     volatile = wl.volatile_mask()
+    # two-phase sync discipline (durable_sync): durable columns survive
+    # a KILL only up to the node's last committed sync — static per
+    # workload, so the whole block compiles away when off
+    sync_on = wl.durable_sync
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
@@ -1161,6 +1274,10 @@ def make_step(
                     f"{rr}; build emits via ctx.emits() (EmitBuilder) to "
                     f"get the right row count"
                 )
+            if emits.sync is None:
+                # hand-built Emits: no sync flag — normalize so the
+                # switch branches share one pytree shape
+                emits = dataclasses.replace(emits, sync=jnp.asarray(False))
             return jnp.asarray(new_state, jnp.int32), emits
 
         return branch
@@ -1443,6 +1560,72 @@ def make_step(
         dup = jnp.where(dispatch & is_dup_kind, kind == KIND_DUP_ON, st.dup)
         skew_id = jnp.where(dispatch & (kind == KIND_SKEW), a0, jnp.int32(-1))
         skew = jnp.where(node_ids == skew_id, a1, st.skew)
+
+        # ---- two-phase sync discipline (Workload.durable_sync) ----
+        # Durable writes buffer until an explicit sync commits them to
+        # the node's disk image; a KILL reverts durable columns to that
+        # image (plus, under an armed TORN mode, a threefry-drawn PREFIX
+        # of the last uncommitted write — the FDB-style torn write).
+        # Everything here is masked selects over (N,)/(N,U) arrays, the
+        # same arithmetic in both layouts; with the discipline off the
+        # arrays are zero-size and the block compiles away entirely.
+        if sync_on:
+            dur_m = jnp.asarray(~vo)  # (U,) the durable-column mask
+            # chaos windows (engine kinds 251-254): per-node flags,
+            # args[0] = node, -1 = every node
+            sel_n = (node_ids == a0) | (a0 < jnp.int32(0))
+            sl_on = dispatch & (kind == KIND_SYNC_LOSS)
+            sl_off = dispatch & (kind == KIND_SYNC_OK)
+            sync_loss = jnp.where(
+                sl_on & sel_n, True,
+                jnp.where(sl_off & sel_n, False, st.sync_loss),
+            )
+            tn_on = dispatch & (kind == KIND_TORN_ON)
+            tn_off = dispatch & (kind == KIND_TORN_OFF)
+            torn = jnp.where(
+                tn_on & sel_n, True,
+                jnp.where(tn_off & sel_n, False, st.torn),
+            )
+            # the LAST durable write: this dispatch's changed durable
+            # columns REPLACE the node's mask (earlier unsynced writes
+            # are wholly lost on a crash; only the newest one tears)
+            changed = (row != state_row) & dur_m  # (U,)
+            wrote = user_dispatch & jnp.any(changed)
+            wmask = jnp.where(
+                (dst_oh & wrote)[:, None], changed[None, :], st.wmask
+            )
+            # sync commit: honored unless the node's disk is lying.
+            # The lie is total — no commit, no wmask clear: the write
+            # stays uncommitted and the next kill still loses/tears it.
+            if dense:
+                lying = jnp.any(sync_loss & dst_oh)
+            else:
+                lying = sync_loss[dst_c] & in_range
+            do_sync = user_dispatch & uem.sync & ~lying
+            sync_lied = user_dispatch & uem.sync & lying
+            commit_sel = (dst_oh & do_sync)[:, None] & dur_m[None, :]
+            disk = jnp.where(commit_sel, node_state, st.disk)
+            wmask = jnp.where((dst_oh & do_sync)[:, None], False, wmask)
+            # crash: durable columns revert to the synced image; an
+            # armed torn mode persists rank < keep_cnt columns (column
+            # order) of the last uncommitted write on top of it
+            torn_bits = draw.bits(PURPOSE_TORN)
+            n_dirty = jnp.sum(wmask.astype(jnp.int32), axis=1)  # (N,)
+            rank = jnp.cumsum(wmask.astype(jnp.int32), axis=1) - 1
+            keep_cnt = (
+                torn_bits % (n_dirty + 1).astype(jnp.uint32)
+            ).astype(jnp.int32)
+            torn_keep = wmask & torn[:, None] & (rank < keep_cnt[:, None])
+            crash_val = jnp.where(torn_keep, node_state, disk)
+            crash_sel = is_killed[:, None] & dur_m[None, :]
+            tore = jnp.any(is_killed & torn)
+            node_state = jnp.where(crash_sel, crash_val, node_state)
+            disk = jnp.where(crash_sel, crash_val, disk)
+            wmask = jnp.where(is_killed[:, None], False, wmask)
+        else:
+            disk, wmask = st.disk, st.wmask
+            sync_loss, torn = st.sync_loss, st.torn
+            do_sync = sync_lied = tore = jnp.asarray(False)
 
         halted = st.halted | (dispatch & (kind == KIND_HALT)) | (has_event & over_limit)
         halt_time = jnp.where(
@@ -1859,10 +2042,17 @@ def make_step(
                 inc[MET_RECORD] = i32(keep)
             # threefry blocks per active event step: the poll-cost/jitter
             # pair + one latency/loss block per emit slot (+ the dup
-            # shadow slots when compiled) — a static count, so this is
+            # shadow slots when compiled, + the torn-prefix draw under
+            # the sync discipline) — a static count, so this is
             # bookkeeping, not instrumentation of the RNG itself
-            blocks = 1 + (k + 1) + (k if dup_rows else 0)
+            blocks = 1 + (k + 1) + (k if dup_rows else 0) + (
+                1 if sync_on else 0
+            )
             inc[MET_RNG] = jnp.where(active, jnp.int32(blocks), 0)
+            if sync_on:
+                inc[MET_SYNC] = do_sync.astype(jnp.int32)
+                inc[MET_SYNC_LOST] = sync_lied.astype(jnp.int32)
+                inc[MET_TORN] = tore.astype(jnp.int32)
             met = st.met + jnp.stack(inc)
             new_halt = halted & ~st.halted
             code = jnp.where(
@@ -1946,6 +2136,10 @@ def make_step(
             slow=slow,
             dup=dup,
             skew=skew,
+            disk=disk,
+            wmask=wmask,
+            sync_loss=sync_loss,
+            torn=torn,
             hist_count=hist_count,
             hist_drop=hist_drop,
             hist_word=hist_word,

@@ -178,10 +178,66 @@ def set_params(lib: ctypes.CDLL, wl: Workload, **model_kwargs) -> None:
         raise ValueError(f"oracle has no implementation of workload {wl.name!r}")
 
 
+def _plan_kinds(plan) -> set:
+    """Static kind set of a chaos plan, whatever form it travels in
+    (FaultPlan via its slot templates, LiteralPlan via its events)."""
+    if hasattr(plan, "slot_templates"):
+        return {int(t.kind) for t in plan.slot_templates()}
+    if hasattr(plan, "events"):
+        return {int(e.kind) for e in plan.events}
+    raise TypeError(f"not a chaos plan: {type(plan).__name__}")
+
+
+def assert_plan_oracle_free(plan) -> None:
+    """Refuse an oracle compare against a plan-driven engine run.
+
+    The oracle has no plan channel at all, and in particular does not
+    implement the extended chaos kinds (engine/core.py 244+ — slow
+    links, duplication, skew, one-way clogs, and the disk-fault kinds
+    SYNC_LOSS/TORN). Before this guard a caller comparing a plan-driven
+    engine sweep against ``run_oracle`` would silently diverge on the
+    first injected event; now the mismatch is a designed error naming
+    the supported verification path.
+    """
+    from .core import FIRST_EXT_KIND
+
+    kinds = _plan_kinds(plan)
+    ext = sorted(k for k in kinds if k >= FIRST_EXT_KIND)
+    if ext:
+        raise ValueError(
+            f"the C++ oracle does not implement extended chaos kinds "
+            f"{ext} (engine kinds >= {FIRST_EXT_KIND}: slow-link/dup/"
+            f"skew/one-way-clog and the SYNC_LOSS/TORN disk faults); "
+            f"plan-driven runs are verified by the two-run/two-layout "
+            f"compare instead (engine.verify.check_layouts / "
+            f"compare_traces)"
+        )
+    raise ValueError(
+        "the C++ oracle takes no fault plan (plans are pre-seeded "
+        "engine pool rows, a channel the oracle does not have); verify "
+        "plan-driven runs with the two-run/two-layout compare instead "
+        "(engine.verify.check_layouts / compare_traces)"
+    )
+
+
 def run_oracle(
-    wl: Workload, cfg: EngineConfig, seed: int, n_steps: int, **model_kwargs
+    wl: Workload, cfg: EngineConfig, seed: int, n_steps: int, plan=None,
+    **model_kwargs,
 ) -> OracleResult:
-    """Run one seed through the C++ oracle."""
+    """Run one seed through the C++ oracle.
+
+    ``plan`` exists only to fail loudly: the oracle cannot execute
+    chaos plans (see :func:`assert_plan_oracle_free`), so passing one
+    raises the designed "verified by two-run/two-layout compare
+    instead" error rather than silently comparing a faulted engine run
+    against an unfaulted oracle run. Sync-discipline workloads
+    (``Workload.durable_sync``) ARE comparable as long as they sync
+    every durable write in the dispatch that made it — the trajectory
+    is then identical to the verbatim-durable semantics the oracle
+    implements (raftlog ``durable=True`` relies on exactly this).
+    """
+    if plan is not None:
+        assert_plan_oracle_free(plan)
     lib = load()
     with ORACLE_LOCK:
         return _run_locked(lib, wl, cfg, seed, n_steps, **model_kwargs)

@@ -45,6 +45,7 @@ __all__ = [
     "PURPOSE_LATENCY",
     "PURPOSE_LOSS",
     "PURPOSE_DUP",
+    "PURPOSE_TORN",
     "PURPOSE_PLAN",
     "PURPOSE_EXPLORE",
     "PURPOSE_USER",
@@ -65,6 +66,13 @@ PURPOSE_POLL_COST = 0
 # jitter rides PURPOSE_POLL_COST lane 1), but the purpose id stays
 # unavailable so old and new layouts never alias.
 PURPOSE_CLOG_JITTER = 1
+# torn-write prefix draw (madsim_tpu.chaos disk faults): when a KILL
+# lands on a node whose torn-write mode is armed, ONE block at this
+# purpose picks how many columns of the last uncommitted durable write
+# survive the crash. Only drawn when the step is built for a
+# Workload.durable_sync workload; counter-addressed like every other
+# purpose, so enabling the discipline never shifts any other draw.
+PURPOSE_TORN = 2
 # per-emit-slot draws: ONE block at PURPOSE_LATENCY+s yields both the
 # latency (lane 0) and loss (lane 1) words via Draw.bits2. PURPOSE_LOSS
 # is reserved/legacy space: the engine no longer draws there, but the

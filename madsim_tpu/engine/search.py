@@ -326,6 +326,12 @@ def search_seeds(
         plan_slots = int(plan.slots)
         if dup_rows is None:
             dup_rows = bool(plan.uses_dup())
+        if cfg.time_limit_ns and hasattr(plan, "validate_windows"):
+            # a fault window opening after the clock cap can never fire:
+            # the sweep would silently certify the unfaulted protocol
+            # (chaos.FaultPlan.validate_windows — warn loudly here,
+            # clamp explicitly via plan.clamped(...))
+            plan.validate_windows(cfg.time_limit_ns)
         rows = plan.compile_batch(seeds, wl=wl)
         if plan_hash is None:
             plan_hash = plan.hash()

@@ -58,6 +58,14 @@ from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
 # sequence, and that is what must agree.
 OP_ELECT = OP_USER
 OP_COMMIT = OP_USER + 1
+# storage-discipline events (durable=True + record=True): a node
+# records OP_SYNCED (arg = its new log length) whenever a sync commits
+# a log-length change, and OP_RECOVER (arg = the log length it came
+# back with) when its post-restart on_init runs. check.recovery_safety
+# over (OP_SYNCED, OP_RECOVER) asserts a restarted node never regresses
+# durably synced state — the crash-recovery-safety detector.
+OP_SYNCED = OP_USER + 2
+OP_RECOVER = OP_USER + 3
 
 _H_INIT = 0
 _H_TIMEOUT = 1  # args = (timer_seq,)
@@ -96,6 +104,7 @@ def make_raftlog(
     chaos: bool = True,
     durable: bool = False,
     record: bool = False,
+    bug: str | None = None,
 ) -> Workload:
     """``record=True`` turns on operation-history recording
     (madsim_tpu.check): every election win records an ``OP_ELECT`` event
@@ -115,11 +124,50 @@ def make_raftlog(
     re-learns commitIndex from its leader's next AppendEntries. The
     default ``durable=False`` keeps the historical diskless behavior
     (restart restores the initial row), which leans on the first
-    retransmission to reinstall the whole log."""
+    retransmission to reinstall the whole log.
+
+    ``durable=True`` now also adopts the engine's **two-phase sync
+    discipline** (``Workload.durable_sync``): the persistent columns
+    survive a kill only up to the node's last ``EmitBuilder.sync``.
+    Every handler that dirties a Figure-2 column syncs in the same
+    dispatch, before its messages go out — the fsync-before-reply
+    placement the paper requires — so with no injected disk faults the
+    trajectory is bit-identical to the pre-discipline durable mode (the
+    revert is a no-op) and the oracle compare stays exact. Chaos
+    ``DiskFault`` plans then exercise torn writes and lying syncs
+    against exactly this surface.
+
+    ``bug="nosync"`` plants the missing-sync mutant: the handlers never
+    call ``sync``, so every "persistent" write really sits in the
+    volatile write buffer and a kill wipes it back to the last synced
+    image (the initial state) — acked votes and committed entries
+    escape before durability, the bug class the FoundationDB/sled DST
+    lineage exists to catch. The committed-value-loss hunt
+    (``tools/store_soak.py``) must find it; correct placement must hold
+    clean under the same fault space.
+
+    With ``record=True`` and ``durable=True`` the model additionally
+    records ``OP_SYNCED`` (a committed log-length change) and
+    ``OP_RECOVER`` (the length a restarted node came back with) events
+    for ``check.recovery_safety``."""
+    if bug not in (None, "nosync"):
+        raise ValueError(f"unknown raftlog bug {bug!r} (only 'nosync')")
+    if bug and not durable:
+        raise ValueError(
+            "bug='nosync' plants a missing-sync mutant: it needs "
+            "durable=True (diskless mode has no syncs to miss)"
+        )
     majority = n_nodes // 2 + 1
     nodes = list(range(n_nodes))
     w = n_writes
     width = LOG0 + w
+    # the correct placement syncs every durable write in the dispatch
+    # that made it; the planted mutant never syncs (see the docstring)
+    sync_en = durable and bug != "nosync"
+    # storage-event recording rides the existing record flag, but only
+    # durable mode has syncs/recoveries to record — diskless histories
+    # stay byte-identical to the pre-storage model
+    rec_store = record and durable
 
     def _lastterm(st):
         """Term of the last log entry (0 for an empty log)."""
@@ -152,6 +200,14 @@ def make_raftlog(
     def on_init(ctx):
         eb = ctx.emits()
         _arm_election(ctx, eb, jnp.int32(1), True)
+        if rec_store:
+            # a re-init at now > 0 is a restarted node reading its disk
+            # back: record what log length it recovered with (the
+            # recovery_safety detector floors this against OP_SYNCED)
+            eb.record(
+                OP_RECOVER, key=0, arg=ctx.state[LOGLEN],
+                when=ctx.now > 0,
+            )
         if chaos:
             # node 0's t=0 init schedules the seed's chaos plan (exactly
             # once per run: restarted nodes re-run on_init, but only the
@@ -185,6 +241,10 @@ def make_raftlog(
                 when=fire & (jnp.int32(p) != ctx.node),
             )
         _arm_election(ctx, eb, st[TSEQ] + 1, fire)
+        if sync_en:
+            # currentTerm/votedFor changed: fsync before the vote
+            # requests leave (Figure 2's persist-before-respond rule)
+            eb.sync(when=fire)
         return new, eb.build()
 
     def on_reqvote(ctx):
@@ -207,6 +267,11 @@ def make_raftlog(
         eb = ctx.emits()
         eb.send(cand, user_kind(_H_GRANT), (term,), when=grant)
         _arm_election(ctx, eb, st1[TSEQ] + 1, grant)
+        if sync_en:
+            # a granted vote (votedFor) — and a bare term bump — must
+            # hit the disk before the grant message can leave: a vote
+            # that survives only in RAM re-votes after a crash
+            eb.sync(when=newer | grant)
         return new, eb.build()
 
     def on_grant(ctx):
@@ -239,6 +304,10 @@ def make_raftlog(
         eb.after(retx_ns, user_kind(_H_RETX), ctx.node, (term,), when=wins)
         if record:
             eb.record(OP_ELECT, key=term, arg=ctx.node, when=wins)
+        if sync_en:
+            # the win-time re-stamp rewrote log entry terms: persist
+            # before re-replicating the suffix
+            eb.sync(when=wins)
         return new, eb.build()
 
     def on_append(ctx):
@@ -273,6 +342,18 @@ def make_raftlog(
         )
         # a heartbeat resets the election timer
         _arm_election(ctx, eb, st[TSEQ] + 1, ok)
+        if sync_en:
+            # adopted entries (and the term bump) fsync before the ack
+            # leaves — THE sync whose absence is the classic
+            # acked-but-not-durable bug (the bug="nosync" mutant)
+            eb.sync(when=ok)
+        if rec_store and sync_en:
+            # a committed log-length change (adoptions that merely
+            # re-install the same length are not length events)
+            eb.record(
+                OP_SYNCED, key=0, arg=idx + 1,
+                when=adopt & (idx + jnp.int32(1) != st[LOGLEN]),
+            )
         return new, eb.build()
 
     def on_ackapp(ctx):
@@ -336,6 +417,12 @@ def make_raftlog(
             propose_ns, user_kind(_H_PROPOSE), ctx.node, (term,),
             when=alive_leader,
         )
+        if sync_en:
+            # the leader's own append fsyncs before it counts its own
+            # ack (it pre-set its ACKS bit above) or replicates
+            eb.sync(when=can)
+        if rec_store and sync_en:
+            eb.record(OP_SYNCED, key=0, arg=st[LOGLEN] + 1, when=can)
         return new, eb.build()
 
     def on_retx(ctx):
@@ -352,7 +439,9 @@ def make_raftlog(
         return ctx.state, eb.build()
 
     return Workload(
-        name="raftlog-record" if record else "raftlog",
+        name="raftlog"
+        + ("-nosync" if bug == "nosync" else "")
+        + ("-record" if record else ""),
         handler_names=("init", "timeout", "reqvote", "grant", "append", "ackapp", "propose", "retx"),
         n_nodes=n_nodes,
         state_width=width,
@@ -374,12 +463,21 @@ def make_raftlog(
             if durable
             else None
         ),
+        # two-phase sync discipline over exactly those columns: a kill
+        # keeps them only up to the node's last EmitBuilder.sync
+        durable_sync=durable,
         # capacity sizing: elections are a handful per run even under
         # chaos; commit records total w plus re-commits after leader
         # changes (a new leader re-records the indices it re-confirms).
-        # Overflow is loud (hist_drop), and search_seeds quarantines it.
+        # Durable mode adds OP_SYNCED length events (per node, per
+        # length change, bounded by w plus truncation churn) and one
+        # OP_RECOVER per restart. Overflow is loud (hist_drop), and
+        # search_seeds quarantines it.
         history=(
-            HistorySpec(capacity=6 * w + 24, max_records=max(w, 1))
+            HistorySpec(
+                capacity=6 * w + 24 + (n_nodes * (w + 6) if durable else 0),
+                max_records=max(w, 1),
+            )
             if record
             else None
         ),

@@ -14,10 +14,10 @@ of ``engine.replay.ReplayEvent``) into the Trace Event JSON format that
   dispatch, which is the overwhelmingly common case; a conservative
   visual approximation otherwise);
 * **chaos spans** — kill/restart, pause/resume, clog/unclog (node,
-  link, and one-way forms), slow/unslow, and dup on/off pairs from the
-  dispatched stream become duration slices on a dedicated "chaos"
-  process, so a shrunk fault plan reads as shaded bands over the
-  protocol's tracks.
+  link, and one-way forms), slow/unslow, dup on/off, and disk-fault
+  (lying-fsync / torn-write) window pairs from the dispatched stream
+  become duration slices on a dedicated "chaos" process, so a shrunk
+  fault plan reads as shaded bands over the protocol's tracks.
 
 The export is a pure function of the decoded events: the count of
 ``cat == "dispatch"`` slices always equals the timeline length (the
@@ -42,6 +42,10 @@ from ..engine.core import (
     KIND_RESUME,
     KIND_SKEW,
     KIND_SLOW_LINK,
+    KIND_SYNC_LOSS,
+    KIND_SYNC_OK,
+    KIND_TORN_OFF,
+    KIND_TORN_ON,
     KIND_UNCLOG,
     KIND_UNCLOG_1W,
     KIND_UNCLOG_NODE,
@@ -80,6 +84,15 @@ _SPAN_PAIRS = {
         ),
     ),
     KIND_DUP_ON: (KIND_DUP_OFF, lambda a: ("dup",), lambda a: "duplication"),
+    # disk-fault windows (chaos.DiskFault): a0 = node, -1 = every node
+    KIND_SYNC_LOSS: (
+        KIND_SYNC_OK, lambda a: ("syncloss", a[0]),
+        lambda a: f"lying fsync {'n%d' % a[0] if a[0] >= 0 else '*'}",
+    ),
+    KIND_TORN_ON: (
+        KIND_TORN_OFF, lambda a: ("torn", a[0]),
+        lambda a: f"torn writes {'n%d' % a[0] if a[0] >= 0 else '*'}",
+    ),
 }
 _SPAN_CLOSERS = {v[0]: k for k, v in _SPAN_PAIRS.items()}
 

@@ -25,13 +25,24 @@ SHELL   := /bin/bash
 
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
-        store-soak clean
+        store-soak lint lint-soak clean
 
-check: native test determinism bench-smoke
+check: native lint test determinism bench-smoke
 	@echo "== make check: all gates passed =="
 
-check-full: native test-full determinism bench-smoke
+check-full: native lint test-full determinism bench-smoke
 	@echo "== make check-full: all gates passed =="
+
+# Static determinism analysis (madsim_tpu.lint): the repo-wide
+# nondeterminism-leak linter (fails on any new finding; intentional
+# real-mode sites carry checked `# lint: allow(rule)` pragmas) plus a
+# jaxpr non-interference smoke (raft/record + raftlog/durable, all obs
+# taps on). The full model x config matrix is `make lint-soak`.
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m madsim_tpu.lint --jaxpr
+
+lint-soak:
+	$(PY) tools/lint_soak.py
 
 native:
 	$(MAKE) -C native

@@ -108,7 +108,7 @@ def probe_platform(timeout: float) -> tuple[str, str]:
 def parent() -> None:
     budget = float(os.environ.get("BENCH_BUDGET", "1500"))
     per_cfg_cap = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "600"))
-    t_start = time.monotonic()
+    t_start = time.monotonic()  # lint: allow(wall-clock)
 
     mode, platform = probe_platform(timeout=min(120.0, budget / 4))
     print(f"# probe: mode={mode} platform={platform}", file=sys.stderr)
@@ -127,7 +127,7 @@ def parent() -> None:
             if stop_on_degrade and cur == "cpu":
                 print(f"# retry degraded, skipping {config}", file=sys.stderr)
                 continue
-            remaining = budget - (time.monotonic() - t_start)
+            remaining = budget - (time.monotonic() - t_start)  # lint: allow(wall-clock)
             if remaining < 60 and results:
                 print(f"# budget exhausted, skipping {config}", file=sys.stderr)
                 continue
@@ -137,7 +137,7 @@ def parent() -> None:
             if res is None and cfg_mode == "default":
                 # accelerator wedged mid-run: degrade this + later configs
                 cur = "cpu"
-                remaining = budget - (time.monotonic() - t_start)
+                remaining = budget - (time.monotonic() - t_start)  # lint: allow(wall-clock)
                 if config not in results:  # keep any prior (TPU) result
                     res = _run_child(
                         "cpu", config, n_seeds, n_steps,
@@ -160,7 +160,7 @@ def parent() -> None:
     # accelerator was unavailable (at probe time or mid-sweep), re-probe
     # after the CPU pass and re-measure the accelerator configs — fresh
     # runs only, never a replay of stale numbers.
-    remaining = budget - (time.monotonic() - t_start)
+    remaining = budget - (time.monotonic() - t_start)  # lint: allow(wall-clock)
     if mode == "cpu" and remaining > 180:
         retry_mode, retry_platform = probe_platform(timeout=min(120.0, remaining / 3))
         print(
@@ -200,7 +200,7 @@ def _banked_tpu_headline() -> dict | None:
     if not paths:
         return None
     newest = max(paths, key=os.path.getmtime)
-    age_h = (time.time() - os.path.getmtime(newest)) / 3600.0
+    age_h = (time.time() - os.path.getmtime(newest)) / 3600.0  # lint: allow(wall-clock)
     if age_h > 48.0:
         # a rounds-old artifact describes a different engine; don't
         # present it as this round's number
@@ -383,9 +383,9 @@ def child(config: str) -> None:
             run.compute(init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64)))
         )  # compile outside the timed window
         cal = init(np.arange(CPU_CALIBRATE_SEEDS, dtype=np.uint64))
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
         jax.block_until_ready(run.compute(cal))
-        per_seed = (time.perf_counter() - t0) / CPU_CALIBRATE_SEEDS
+        per_seed = (time.perf_counter() - t0) / CPU_CALIBRATE_SEEDS  # lint: allow(wall-clock)
         fit = int(CPU_CELL_TARGET_S / max(per_seed, 1e-9))
         sized = CPU_CALIBRATE_SEEDS
         while sized * 2 <= min(fit, n_seeds):

@@ -49,9 +49,9 @@ def timed(name, body, state):
     cheap variants get proportionally longer scans)."""
     cal = jax.jit(scan_n(body, N_STEPS))
     jax.block_until_ready(cal(state))  # compile
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # lint: allow(wall-clock)
     jax.block_until_ready(cal(state))
-    cal_wall = time.perf_counter() - t0
+    cal_wall = time.perf_counter() - t0  # lint: allow(wall-clock)
 
     steps = N_STEPS
     while cal_wall * (steps / N_STEPS) < TARGET_WALL_S and steps < 2_000_000:
@@ -64,9 +64,9 @@ def timed(name, body, state):
     # otherwise the compile wall would satisfy the target spuriously.
     for _ in range(6):
         jax.block_until_ready(jfn(state))  # compile / cache hit, untimed
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
         jax.block_until_ready(jfn(state))
-        warm = time.perf_counter() - t0
+        warm = time.perf_counter() - t0  # lint: allow(wall-clock)
         if warm >= TARGET_WALL_S * 0.6 or steps >= 2_000_000:
             break
         per_step = warm / steps
@@ -79,9 +79,9 @@ def timed(name, body, state):
     # by exhaustion with a freshly re-jitted, never-executed program)
     times = []
     for _ in range(REPEATS):
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
         jax.block_until_ready(jfn(state))
-        times.append(time.perf_counter() - t0)
+        times.append(time.perf_counter() - t0)  # lint: allow(wall-clock)
     wall = sorted(times)[len(times) // 2]
     us_per_step = wall / steps * 1e6
     rec = {

@@ -69,9 +69,9 @@ async def pipeline(n_jobs: int, n_workers: int) -> dict:
 # ----------------------------------------------------------------------
 @ms.test
 async def main():
-    wall0 = time.monotonic()  # interposed: virtual seconds
+    wall0 = time.monotonic()  # interposed: virtual seconds  # lint: allow(wall-clock)
     out = await pipeline(n_jobs=12, n_workers=3)
-    print(f"virtual elapsed: {time.monotonic() - wall0:.3f}s (simulated)")
+    print(f"virtual elapsed: {time.monotonic() - wall0:.3f}s (simulated)")  # lint: allow(wall-clock)
     print(f"completed={out['completed']}")
     print(f"gave_up  ={out['gave_up']}")
     assert sorted(out["completed"] + out["gave_up"]) == list(range(12))

@@ -46,21 +46,21 @@ async def main():
 
     # empty-RPC latency (rpc.rs:11-26)
     n = 2000
-    t0 = time.perf_counter()
+    t0 = time.perf_counter()  # lint: allow(wall-clock)
     for _ in range(n):
         await client.call(addr, Empty())
-    dt = time.perf_counter() - t0
+    dt = time.perf_counter() - t0  # lint: allow(wall-clock)
     print(f"empty rpc: {dt / n * 1e6:.1f} us/op  ({n / dt:.0f} op/s)")
 
     # payload throughput 16 B - 1 MiB (rpc.rs:28-55)
     for size in (16, 256, 4096, 65536, 1 << 20):
         data = b"\x00" * size
         reps = max(4, min(500, (64 << 20) // max(size, 1) // 8))
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
         for _ in range(reps):
             got_n, _ = await client.call_with_data(addr, Payload(size), data)
             assert got_n == size
-        dt = time.perf_counter() - t0
+        dt = time.perf_counter() - t0  # lint: allow(wall-clock)
         mb = size * reps * 2 / 1e6  # both directions
         print(
             f"payload {size:>8}B: {dt / reps * 1e6:>8.1f} us/op  "
@@ -110,16 +110,16 @@ def native_transport_bench():
             send(a, b"127.0.0.1", pb.value, 1, b"x", 1)
             free(recv(b, 1, 5000))
             n = 2000
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # lint: allow(wall-clock)
             for _ in range(n):
                 send(a, b"127.0.0.1", pb.value, 1, b"x", 1)
                 free(recv(b, 1, 5000))
                 send(b, b"127.0.0.1", pa.value, 2, b"y", 1)
                 free(recv(a, 2, 5000))
-            rtt = (time.perf_counter() - t0) / n
+            rtt = (time.perf_counter() - t0) / n  # lint: allow(wall-clock)
             blob = b"z" * 65536
             reps = 2000
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # lint: allow(wall-clock)
             sent = received = 0
             while received < reps:
                 while sent < reps and sent - received < 32:
@@ -127,7 +127,7 @@ def native_transport_bench():
                     sent += 1
                 free(recv(b, 3, 10000))
                 received += 1
-            dt = time.perf_counter() - t0
+            dt = time.perf_counter() - t0  # lint: allow(wall-clock)
             print(
                 f"{label}: rtt {rtt * 1e6:>6.1f} us   "
                 f"64KiB one-way {len(blob) * reps / dt / 1e9:>5.2f} GB/s"

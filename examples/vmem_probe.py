@@ -36,9 +36,9 @@ def timed(tag, fn, state):
     jax.block_until_ready(fn(state))  # compile
     walls = []
     for _ in range(REPEATS):
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
         out = jax.block_until_ready(fn(state))
-        walls.append(time.perf_counter() - t0)
+        walls.append(time.perf_counter() - t0)  # lint: allow(wall-clock)
     wall = float(np.median(walls))
     rec = {
         "variant": tag,

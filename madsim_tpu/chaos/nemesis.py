@@ -163,12 +163,23 @@ class Nemesis:
             handle.set_clock_skew(a, ev.a1)
         elif ev.kind in (KIND_SYNC_LOSS, KIND_SYNC_OK):
             # storage faults land on FsSim — the dual of the engine's
-            # sync-discipline state (fs.py injectable-fault hooks)
+            # sync-discipline state (fs.py injectable-fault hooks).
+            # a1 is the window mode: 0 = silent lie (sync_all lies),
+            # 1 = observable EIO (writes raise OSError(EIO), the dual
+            # of the engine's ctx.sync_err). SYNC_OK ends both.
             from ..fs import FsSim
 
             sim = handle.simulator(FsSim)
+            on = ev.kind == KIND_SYNC_LOSS
+            eio = bool(ev.a1 == 1)
             for nid in self._targets(handle, ev.a0):
-                sim.set_sync_loss(nid, ev.kind == KIND_SYNC_LOSS)
+                if not on:
+                    sim.set_sync_loss(nid, False)
+                    sim.set_fail_writes(nid, False)
+                elif eio:
+                    sim.set_fail_writes(nid, True)
+                else:
+                    sim.set_sync_loss(nid, True)
         elif ev.kind in (KIND_TORN_ON, KIND_TORN_OFF):
             from ..fs import FsSim
 

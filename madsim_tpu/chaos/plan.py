@@ -697,13 +697,20 @@ class DiskFault:
     firmware-lies-about-fsync fault; note a lying disk breaks the
     assumptions raft-class protocols are allowed to make, so clean-model
     certificates run torn-only windows and use sync-loss as the
-    positive control for the recovery-safety detector. On workloads
-    without the sync discipline every window is a no-op (the identity-
-    defaults rule of the other extended kinds)."""
+    positive control for the recovery-safety detector. ``n_eio``
+    windows make the node's disk fail *observably*: syncs stop
+    committing AND the node's handlers see ``ctx.sync_err`` for the
+    duration — the batched ``FsSim.set_fail_writes`` ``OSError(EIO)``.
+    Unlike a lie, an EIO is a fault correct code is expected to
+    SURVIVE (withhold the ack you could not persist), so EIO windows
+    belong in clean-model certificates. On workloads without the sync
+    discipline every window is a no-op (the identity-defaults rule of
+    the other extended kinds)."""
 
     targets: tuple
     n_torn: int = 1
     n_sync_loss: int = 0
+    n_eio: int = 0
     t_min_ns: int = 20_000_000
     t_max_ns: int = 400_000_000
     dur_min_ns: int = 50_000_000
@@ -712,41 +719,46 @@ class DiskFault:
     def __post_init__(self):
         if not self.targets:
             raise ValueError("DiskFault needs at least one target node")
-        if self.n_torn < 0 or self.n_sync_loss < 0:
+        if self.n_torn < 0 or self.n_sync_loss < 0 or self.n_eio < 0:
             raise ValueError("window counts must be >= 0")
-        if self.n_torn + self.n_sync_loss < 1:
+        if self.n_torn + self.n_sync_loss + self.n_eio < 1:
             raise ValueError(
-                "DiskFault needs at least one torn or sync-loss window"
+                "DiskFault needs at least one torn, sync-loss or EIO "
+                "window"
             )
         _check_window(self.t_min_ns, self.t_max_ns, "disk-fault-time")
         _check_window(self.dur_min_ns, self.dur_max_ns, "disk-fault-duration")
 
     @property
     def slots(self) -> int:
-        return 2 * (self.n_torn + self.n_sync_loss)
+        return 2 * (self.n_torn + self.n_sync_loss + self.n_eio)
 
     def _windows(self):
-        """(on-kind, off-kind) per window, torn windows first — the
-        spec-offset rule: growing n_sync_loss never re-randomizes the
-        torn windows before it."""
-        return [(KIND_TORN_ON, KIND_TORN_OFF)] * self.n_torn + [
-            (KIND_SYNC_LOSS, KIND_SYNC_OK)
-        ] * self.n_sync_loss
+        """(on-kind, off-kind, on-mode) per window, torn windows first,
+        then sync-loss, then EIO — the spec-offset rule: growing a
+        later count never re-randomizes the windows before it. The
+        mode word is KIND_SYNC_LOSS's args[1]: 0 = silent lie, 1 =
+        observable EIO (ctx.sync_err)."""
+        return (
+            [(KIND_TORN_ON, KIND_TORN_OFF, 0)] * self.n_torn
+            + [(KIND_SYNC_LOSS, KIND_SYNC_OK, 0)] * self.n_sync_loss
+            + [(KIND_SYNC_LOSS, KIND_SYNC_OK, 1)] * self.n_eio
+        )
 
     def compile_batch(self, seeds, slot: int, xp=np):
         st = _Stream(seeds, slot, xp)
         rows = []
-        for i, (k_on, k_off) in enumerate(self._windows()):
+        for i, (k_on, k_off, mode) in enumerate(self._windows()):
             who = st.pick(self.targets, 3 * i)
             at = st.uniform(self.t_min_ns, self.t_max_ns, 3 * i + 1)
             dur = st.uniform(self.dur_min_ns, self.dur_max_ns, 3 * i + 2)
-            rows.append((at, k_on, who, 0, True))
+            rows.append((at, k_on, who, mode, True))
             rows.append((at + dur, k_off, who, 0, True))
         return _pack_slots(xp, len(seeds), rows)
 
     def slot_templates(self) -> tuple:
         out = []
-        for k_on, k_off in self._windows():
+        for k_on, k_off, _mode in self._windows():
             out.append(SlotTemplate(
                 kind=k_on, t_min_ns=self.t_min_ns, t_max_ns=self.t_max_ns,
                 targets=self.targets,

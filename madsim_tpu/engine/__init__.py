@@ -18,6 +18,8 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .core import (  # noqa: E402,F401
+    DERIVED_STATE_FIELDS,
+    STORAGE_STATE_FIELDS,
     FIRST_EXT_KIND,
     FIRST_USER_KIND,
     KIND_CLOG,
@@ -56,6 +58,8 @@ from .core import (  # noqa: E402,F401
     PlanRows,
     SimState,
     Workload,
+    core_fields,
+    derived_fields,
     make_init,
     make_run,
     make_run_while,

@@ -34,9 +34,11 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # coverage fingerprint (cov/cov_last, madsim_tpu.explore); format 6:
 # observability columns (cov_hits/met/tl_*, madsim_tpu.obs); format 7:
 # storage sync-discipline columns (disk/wmask/sync_loss/torn,
-# madsim_tpu.chaos disk faults). Older checkpoints are rejected with
-# the designed mismatch error rather than a KeyError mid-load
-_FORMAT = 7
+# madsim_tpu.chaos disk faults); format 8: the observable fsync-EIO
+# window column (sync_eio, ctx.sync_err). Older checkpoints are
+# rejected with the designed mismatch error rather than a KeyError
+# mid-load
+_FORMAT = 8
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

@@ -116,6 +116,10 @@ __all__ = [
     "make_step",
     "make_run",
     "time32_eligible",
+    "DERIVED_STATE_FIELDS",
+    "STORAGE_STATE_FIELDS",
+    "derived_fields",
+    "core_fields",
 ]
 
 _INF_NS = np.int64(2**62)
@@ -218,9 +222,15 @@ KIND_UNCLOG_1W = 250
 # disk-fault kinds (madsim_tpu.chaos DiskFault; only meaningful for
 # Workload.durable_sync workloads — a no-op otherwise, like DUP_ON
 # without dup_rows). args[0] = target node, -1 = every node.
-KIND_SYNC_LOSS = 251  # the node's disk starts LYING: sync commits are
-#                       silently dropped (the committed bit never sets)
-KIND_SYNC_OK = 252  # end of the sync-lie window: syncs commit again
+KIND_SYNC_LOSS = 251  # args[1]=0 (default): the node's disk starts
+#                       LYING — sync commits are silently dropped (the
+#                       committed bit never sets). args[1]=1: the disk
+#                       starts FAILING — syncs still don't commit, but
+#                       the fault is OBSERVABLE: handlers see
+#                       ctx.sync_err while the window is open, the
+#                       batched analog of FsSim.set_fail_writes raising
+#                       OSError(EIO)
+KIND_SYNC_OK = 252  # end of the sync-lie/EIO window: syncs commit again
 KIND_TORN_ON = 253  # arm torn-write mode: the next KILL persists only a
 #                     threefry-drawn PREFIX of the last uncommitted
 #                     durable write (PURPOSE_TORN) on top of the synced
@@ -256,7 +266,9 @@ MET_HALT_CODE = 12  # not a counter: HALT_* code of how the seed stopped
 # storage-fault counters (Workload.durable_sync; always 0 otherwise).
 # Appended after MET_HALT_CODE so every pre-existing slot id is stable.
 MET_SYNC = 13  # sync commits honored (EmitBuilder.sync, disk committed)
-MET_SYNC_LOST = 14  # syncs swallowed by a KIND_SYNC_LOSS lie window
+MET_SYNC_LOST = 14  # syncs that failed to commit inside a
+#                     KIND_SYNC_LOSS window — silently (lie mode) or
+#                     observably (EIO mode, ctx.sync_err)
 MET_TORN = 15  # kills that landed inside an armed torn-write window
 #                (whether bytes actually tore depends on an uncommitted
 #                write being outstanding — on a correct fsync-everywhere
@@ -276,6 +288,63 @@ HALT_DONE = 1  # workload emitted KIND_HALT: scenario complete
 HALT_TIME_LIMIT = 2  # cfg.time_limit_ns tripped
 HALT_IDLE = 3  # event pool ran empty while unhalted (a deadlocked seed:
 #                nothing pending, nothing will ever be)
+
+
+# ---------------------------------------------------------------------------
+# Derived-state manifest (madsim_tpu.lint). The engine's observability
+# discipline — "off = zero-size arrays + bit-identical traces" — rests
+# on these SimState fields being WRITE-ONLY with respect to the
+# trajectory: the step may read them to append to them, but no value
+# derived from them may ever reach a core column, an RNG draw, or the
+# trace fold. The names below are the stable taint-source vocabulary
+# the static non-interference proof (lint.check_noninterference) tags
+# and the isolation-frontier report cites; obs.explain's views use the
+# same field names, so a reported leak names exactly the columns a
+# forensics reader already knows.
+# ---------------------------------------------------------------------------
+
+# always derived, whatever the build flags: history columns
+# (madsim_tpu.check), the coverage fingerprint (explore), fleet metrics
+# and the timeline ring (obs). With the matching tap off they are
+# zero-size arrays — trivially non-interfering — and with it on the
+# proof obligation is exactly the bit-identity the runtime tests sample.
+DERIVED_STATE_FIELDS = (
+    "hist_count", "hist_drop", "hist_word", "hist_t",
+    "cov", "cov_last", "cov_hits",
+    "met",
+    "tl_count", "tl_drop", "tl_t", "tl_meta", "tl_args", "tl_pay",
+)
+
+# the two-phase sync-discipline columns: derived (zero-size) when
+# Workload.durable_sync is off, CORE when it is on — a crash then reads
+# the disk image back into node_state, a legitimate feedback path.
+STORAGE_STATE_FIELDS = ("disk", "wmask", "sync_loss", "sync_eio", "torn")
+
+
+def derived_fields(wl: "Workload") -> tuple:
+    """SimState field names that are derived-only for this workload.
+
+    The manifest the static non-interference proof (madsim_tpu.lint)
+    taints: no data path from any of these fields may reach a field
+    outside the set (nor the trace fold, which lives in the core
+    ``trace`` field). Build flags (metrics/cov_words/timeline_cap)
+    don't change membership — an off tap is a zero-size array whose
+    non-interference is vacuous — but the sync discipline does: its
+    columns feed ``node_state`` on a crash when ``durable_sync`` is on.
+    """
+    out = DERIVED_STATE_FIELDS
+    if not wl.durable_sync:
+        out = out + STORAGE_STATE_FIELDS
+    return out
+
+
+def core_fields(wl: "Workload") -> tuple:
+    """Complement of :func:`derived_fields` over the SimState fields."""
+    derived = set(derived_fields(wl))
+    return tuple(
+        f.name for f in dataclasses.fields(SimState)
+        if f.name not in derived
+    )
 
 
 def pack_slow_arg(b, mult):
@@ -509,8 +578,14 @@ class EmitBuilder:
         (node=-1: every node). See ``chaos.DiskFault`` for the plan form."""
         self.after(0, KIND_SYNC_LOSS, 0, (node,), when)
 
+    def sync_eio(self, node, when=True):
+        """Chaos: the node's disk starts FAILING observably — syncs stop
+        committing and the node's handlers see ``ctx.sync_err`` until a
+        ``sync_ok`` (the batched ``FsSim.set_fail_writes``)."""
+        self.after(0, KIND_SYNC_LOSS, 0, (node, 1), when)
+
     def sync_ok(self, node, when=True):
-        """Chaos: end the node's sync-lie window."""
+        """Chaos: end the node's sync-lie AND fsync-EIO windows."""
         self.after(0, KIND_SYNC_OK, 0, (node,), when)
 
     def torn_on(self, node, when=True):
@@ -655,6 +730,14 @@ class HandlerCtx:
     payload_words: int = 0
     args_words: int = 4
     max_records: int = 0  # history record slots (Workload.history)
+    # () bool — the handling node is inside an injected fsync-EIO
+    # window (KIND_SYNC_LOSS mode 1, chaos.DiskFault n_eio): its syncs
+    # are failing OBSERVABLY, the batched analog of FsSim's
+    # set_fail_writes OSError(EIO). Always False when the workload has
+    # no sync discipline or no EIO window is open, so handlers that
+    # gate on it (e.g. withhold an ack they cannot persist) are
+    # value-identical to ungated ones on every fault-free trajectory.
+    sync_err: jnp.ndarray = None
 
     def emits(self) -> EmitBuilder:
         return EmitBuilder(
@@ -825,6 +908,11 @@ class SimState:
     disk: jnp.ndarray  # (D,U) int32 synced durable image
     wmask: jnp.ndarray  # (D,U) bool last uncommitted durable write's columns
     sync_loss: jnp.ndarray  # (D,) bool — sync-lie window active (chaos)
+    sync_eio: jnp.ndarray  # (D,) bool — observable fsync-EIO window
+    #   active (chaos): syncs fail AND handlers see ctx.sync_err, the
+    #   batched FsSim.set_fail_writes. A lie window hides the failure;
+    #   an EIO window reports it — the two bug surfaces differ exactly
+    #   in whether correct code can react.
     torn: jnp.ndarray  # (D,) bool — torn-write mode armed (chaos)
     # operation history (madsim_tpu.check), H = HistorySpec.capacity
     # (0 when Workload.history is None). Rows are append-ordered by
@@ -1056,6 +1144,7 @@ def make_init(
             disk=(base_state if d else jnp.zeros((0, u), jnp.int32)),
             wmask=jnp.zeros((d, u), jnp.bool_),
             sync_loss=jnp.zeros((d,), jnp.bool_),
+            sync_eio=jnp.zeros((d,), jnp.bool_),
             torn=jnp.zeros((d,), jnp.bool_),
             hist_count=jnp.int32(0),
             hist_drop=jnp.int32(0),
@@ -1238,7 +1327,7 @@ def make_step(
     # lax.switch operands must be pytrees, so the context travels as a
     # tuple of arrays and each branch rebuilds the HandlerCtx view.
     def _unpack(op) -> HandlerCtx:
-        now, node, state, args, src, k0, k1, stp, pay = op
+        now, node, state, args, src, k0, k1, stp, pay, eio = op
         return HandlerCtx(
             now=now,
             node=node,
@@ -1251,6 +1340,7 @@ def make_step(
             payload_words=w,
             args_words=aw,
             max_records=rr,
+            sync_err=eio,
         )
 
     def _user_branch(handler):
@@ -1380,6 +1470,16 @@ def make_step(
             paused_dst = st.paused[dst_c] & in_range
             epoch_dst = jnp.where(in_range, st.epoch[dst_c], 0)
             skew_dst = jnp.where(in_range, st.skew[dst_c], 0)
+        # the handling node's observable fsync-EIO bit (ctx.sync_err):
+        # pre-dispatch state, like every other ctx view. Constant False
+        # without the sync discipline — the gate compiles away.
+        if sync_on:
+            if dense:
+                eio_dst = jnp.any(st.sync_eio & dst_oh)
+            else:
+                eio_dst = st.sync_eio[dst_c] & in_range
+        else:
+            eio_dst = jnp.asarray(False)
 
         # liveness/epoch gate: user events to a dead or reincarnated node
         # are dropped — the kill-drops-futures semantics of task.rs:255-276
@@ -1466,7 +1566,7 @@ def make_step(
             user_now = now + skew_dst.astype(jnp.int64)
             operand = (
                 user_now, dst, state_row, args, src,
-                draw.k0, draw.k1, draw.step, pay_i,
+                draw.k0, draw.k1, draw.step, pay_i, eio_dst,
             )
             user_state, uem = lax.switch(user_idx, user_branches, operand)
         else:
@@ -1574,11 +1674,20 @@ def make_step(
             # chaos windows (engine kinds 251-254): per-node flags,
             # args[0] = node, -1 = every node
             sel_n = (node_ids == a0) | (a0 < jnp.int32(0))
-            sl_on = dispatch & (kind == KIND_SYNC_LOSS)
+            # args[1] picks the window mode: 0 = silent lie (the
+            # historical default, so pre-EIO plans are bit-identical),
+            # 1 = observable EIO (ctx.sync_err). SYNC_OK ends both.
+            eio_mode = a1 == jnp.int32(1)
+            sl_on = dispatch & (kind == KIND_SYNC_LOSS) & ~eio_mode
+            ei_on = dispatch & (kind == KIND_SYNC_LOSS) & eio_mode
             sl_off = dispatch & (kind == KIND_SYNC_OK)
             sync_loss = jnp.where(
                 sl_on & sel_n, True,
                 jnp.where(sl_off & sel_n, False, st.sync_loss),
+            )
+            sync_eio = jnp.where(
+                ei_on & sel_n, True,
+                jnp.where(sl_off & sel_n, False, st.sync_eio),
             )
             tn_on = dispatch & (kind == KIND_TORN_ON)
             tn_off = dispatch & (kind == KIND_TORN_OFF)
@@ -1594,13 +1703,16 @@ def make_step(
             wmask = jnp.where(
                 (dst_oh & wrote)[:, None], changed[None, :], st.wmask
             )
-            # sync commit: honored unless the node's disk is lying.
-            # The lie is total — no commit, no wmask clear: the write
-            # stays uncommitted and the next kill still loses/tears it.
+            # sync commit: honored unless the node's disk is lying or
+            # failing (EIO). Either failure is total — no commit, no
+            # wmask clear: the write stays uncommitted and the next
+            # kill still loses/tears it. The difference is upstream:
+            # an EIO window also showed the handler ctx.sync_err.
+            failing = sync_loss | sync_eio
             if dense:
-                lying = jnp.any(sync_loss & dst_oh)
+                lying = jnp.any(failing & dst_oh)
             else:
-                lying = sync_loss[dst_c] & in_range
+                lying = failing[dst_c] & in_range
             do_sync = user_dispatch & uem.sync & ~lying
             sync_lied = user_dispatch & uem.sync & lying
             commit_sel = (dst_oh & do_sync)[:, None] & dur_m[None, :]
@@ -1624,7 +1736,7 @@ def make_step(
             wmask = jnp.where(is_killed[:, None], False, wmask)
         else:
             disk, wmask = st.disk, st.wmask
-            sync_loss, torn = st.sync_loss, st.torn
+            sync_loss, sync_eio, torn = st.sync_loss, st.sync_eio, st.torn
             do_sync = sync_lied = tore = jnp.asarray(False)
 
         halted = st.halted | (dispatch & (kind == KIND_HALT)) | (has_event & over_limit)
@@ -2139,6 +2251,7 @@ def make_step(
             disk=disk,
             wmask=wmask,
             sync_loss=sync_loss,
+            sync_eio=sync_eio,
             torn=torn,
             hist_count=hist_count,
             hist_drop=hist_drop,

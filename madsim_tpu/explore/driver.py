@@ -420,7 +420,7 @@ def run(
                 )
             rows = stack_plan_rows(plans)
 
-        t_disp = _time.monotonic()
+        t_disp = _time.monotonic()  # lint: allow(wall-clock)
         report = search_seeds(
             wl, cfg, invariant,
             seeds=seeds, max_steps=max_steps, require_halt=require_halt,
@@ -429,7 +429,7 @@ def run(
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
             cov_words=cov_words, cov_hitcount=cov_hitcount,
         )
-        dispatch_wall = _time.monotonic() - t_disp
+        dispatch_wall = _time.monotonic() - t_disp  # lint: allow(wall-clock)
         sims += batch
         failing = ~report.ok & ~report.overflowed
         # overflowed seeds are quarantined from guidance too: their

@@ -1,0 +1,69 @@
+"""madsim_tpu.lint — static determinism analysis.
+
+The engine's whole value proposition is that every source of
+nondeterminism is intercepted and every observability column is
+write-only with respect to the trajectory. Both conventions were
+previously enforced only dynamically — runtime stdlib interposition
+(runtime/intercept.py) plus sampled bit-identity tests. This package
+turns them into *checked invariants* at analysis time:
+
+* :func:`check_noninterference` — traces the compiled step/run function
+  of a (workload, config, build-flags) triple to a jaxpr, taints the
+  derived-state inputs named by ``engine.derived_fields`` (``cov``,
+  ``met``, the ``tl_*`` ring, history columns, the disk columns when
+  the sync discipline is off) and propagates the taint through every
+  equation — including ``scan``/``cond``/``while`` bodies and ``pjit``
+  sub-jaxprs — to prove no data path reaches a core ``SimState`` column
+  or the trace fold. The report is machine-readable: the isolation
+  frontier per equation, and for any leak the offending equation chain
+  plus the source/destination column names (the same names
+  ``obs.explain`` prints).
+* :func:`lint_paths` / :func:`lint_repo` — an AST linter over sim code
+  flagging intercept-bypassing calls (wall clocks, ambient entropy,
+  ``uuid``, un-threefry'd ``np.random``), unordered-set iteration in
+  ordering-sensitive positions, ``id()``/``hash()`` in branch
+  conditions, and host callbacks inside sim code. Intentional
+  real-mode sites carry a ``# lint: allow(<rule>)`` pragma; the
+  allowlist is *checked* — a pragma that suppresses nothing is itself
+  a finding (``unused-allow``).
+
+``make lint`` (or ``python -m madsim_tpu.lint``) runs both and fails
+on any new finding; ``tools/lint_soak.py`` runs the full model×config
+jaxpr matrix.
+"""
+
+from .taint import TaintEqn, TaintResult, analyze_jaxpr  # noqa: F401
+from .noninterference import (  # noqa: F401
+    NonInterferenceReport,
+    check_matrix,
+    check_noninterference,
+    model_matrix,
+    plant_met_leak,
+)
+from .rules import (  # noqa: F401
+    DEFAULT_PATHS,
+    Finding,
+    LintResult,
+    RULES,
+    lint_paths,
+    lint_repo,
+    lint_source,
+)
+
+__all__ = [
+    "TaintEqn",
+    "TaintResult",
+    "analyze_jaxpr",
+    "NonInterferenceReport",
+    "check_matrix",
+    "check_noninterference",
+    "model_matrix",
+    "plant_met_leak",
+    "DEFAULT_PATHS",
+    "Finding",
+    "LintResult",
+    "RULES",
+    "lint_paths",
+    "lint_repo",
+    "lint_source",
+]

@@ -1,0 +1,97 @@
+"""``python -m madsim_tpu.lint`` — the ``make lint`` entry point.
+
+Runs the repo-wide nondeterminism-leak linter (fails on any finding)
+and, with ``--jaxpr``, a non-interference smoke over a small slice of
+the model matrix (the full matrix lives in tools/lint_soak.py). Exit
+status 0 = clean, 1 = findings, the usual linter contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m madsim_tpu.lint",
+        description="static determinism analysis (madsim_tpu.lint)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the repo surface)",
+    )
+    ap.add_argument(
+        "--jaxpr",
+        action="store_true",
+        help="also run the non-interference smoke (raft + raftlog/durable)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--show-allowed",
+        action="store_true",
+        help="print the checked allowlist (pragma inventory)",
+    )
+    args = ap.parse_args(argv)
+
+    from .rules import lint_paths, lint_repo
+
+    result = lint_paths(args.paths) if args.paths else lint_repo()
+
+    reports = []
+    if args.jaxpr:
+        from .noninterference import BUILD_AXES, check_matrix, model_matrix
+
+        want = ("raft/record", "raftlog/durable")
+        models = [m for m in model_matrix() if m[0] in want]
+        if len(models) != len(want):
+            # fail LOUDLY on tag drift: a silent miss would either
+            # halve the smoke or (via the empty-filter fallback) trace
+            # the full 9-model matrix inside the tier-1 budget
+            raise SystemExit(
+                f"lint --jaxpr: expected tags {want} in model_matrix(), "
+                f"found {[m[0] for m in models]} — update the smoke "
+                f"filter to match models/*.py lint_entries()"
+            )
+        # the same 'all' axis the soak matrix certifies — a new build
+        # flag added there is automatically smoked here too
+        reports = check_matrix(models, {"all": BUILD_AXES["all"]})
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in result.findings],
+                    "allowed": [f.to_dict() for f in result.allowed],
+                    "n_files": result.n_files,
+                    "noninterference": [r.to_dict() for r in reports],
+                },
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in result.findings:
+            print(str(f))
+            if f.snippet:
+                print(f"    {f.snippet}")
+        if args.show_allowed:
+            for f in result.allowed:
+                print(f"ALLOWED {f}")
+        for r in reports:
+            print(r.summary())
+        print(
+            f"lint: {result.n_files} files, {len(result.findings)} "
+            f"finding(s), {len(result.allowed)} allowlisted site(s)"
+            + (f", {len(reports)} non-interference proofs" if reports else "")
+        )
+
+    bad = bool(result.findings) or any(not r.ok for r in reports)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,326 @@
+"""Static non-interference proof for the engine's derived state.
+
+The engine's observability columns (coverage, metrics, timeline,
+history — and the disk columns when the sync discipline is off) carry a
+contract: *derived state only* — the step may append to them but no
+value computed from them may reach a core ``SimState`` column, an RNG
+draw, or the trace fold. PRs 1-5 verified this dynamically (off = zero
+size + bit-identical traces, sampled per layout). This module proves
+it statically, per (workload, config, build flags): trace the step (or
+run) function to a jaxpr, taint the derived input leaves with their
+``engine.derived_fields`` names, propagate (lint.taint), and require
+every CORE output leaf to come back label-free.
+
+The report is machine-readable and cites SimState **field names** — the
+same column vocabulary ``obs.explain`` narrates with — so a leak reads
+like "``met`` reaches ``step`` via eqns[412]:add", not like an XLA dump.
+
+The dynamic identity tests and this proof are complementary: the tests
+catch semantic drift the type system can't see; the proof catches
+value-identical-but-data-dependent edges (e.g. ``step + met*0``) that
+bit-identity can never witness. :func:`plant_met_leak` builds exactly
+that mutant, and the test suite asserts it is caught.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+import jax
+
+from ..engine.core import (
+    EngineConfig,
+    Workload,
+    derived_fields,
+    make_init,
+    make_run,
+    make_step,
+)
+from .taint import analyze_jaxpr
+
+__all__ = [
+    "NonInterferenceReport",
+    "check_matrix",
+    "check_noninterference",
+    "model_matrix",
+    "plant_met_leak",
+    "BUILD_AXES",
+]
+
+
+def _leaf_names(tree) -> list:
+    """SimState leaf names in flatten order (``.field`` -> ``field``)."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(path).lstrip(".") for path, _leaf in leaves]
+
+
+@dataclasses.dataclass
+class NonInterferenceReport:
+    """Verdict + isolation frontier of one traced (wl, cfg, flags)."""
+
+    workload: str
+    config_hash: str
+    entry: str  # "step" or "run"
+    flags: dict  # the build flags that shaped the traced program
+    derived: tuple  # taint-source field names (engine.derived_fields)
+    # field -> sorted source labels, for EVERY tainted output field.
+    # Derived fields legitimately appear here (they are read-modify-
+    # write); a CORE field appearing is the leak.
+    out_taint: dict
+    # field -> {labels, chain} for core outputs only — the violations
+    leaks: dict
+    # tainted equations: [{path, prim, sources, mixes_clean}]
+    frontier: list
+    n_eqns: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.leaks
+
+    def to_dict(self) -> dict:
+        return {
+            "workload": self.workload,
+            "config_hash": self.config_hash,
+            "entry": self.entry,
+            "flags": self.flags,
+            "derived": list(self.derived),
+            "out_taint": self.out_taint,
+            "leaks": self.leaks,
+            "frontier": self.frontier,
+            "n_eqns": self.n_eqns,
+            "ok": self.ok,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def summary(self) -> str:
+        what = (
+            f"{self.workload} [{self.entry}] flags="
+            f"{{{', '.join(f'{k}={v}' for k, v in sorted(self.flags.items()) if v)}}}"
+        )
+        if self.ok:
+            return (
+                f"OK   {what}: {len(self.derived)} tainted columns stay "
+                f"isolated over {self.n_eqns} equations "
+                f"({len(self.frontier)} on the frontier)"
+            )
+        lines = [f"LEAK {what}:"]
+        for field, info in self.leaks.items():
+            lines.append(
+                f"  derived {sorted(info['labels'])} reaches core "
+                f"column {field!r}"
+            )
+            for hop in info["chain"]:
+                lines.append(
+                    f"    via {hop['path']}:{hop['prim']} "
+                    f"(sources {hop['sources']})"
+                )
+        return "\n".join(lines)
+
+
+def check_noninterference(
+    wl: Workload,
+    cfg: EngineConfig,
+    *,
+    entry: str = "step",
+    layout: str = "scatter",
+    time32: bool = False,
+    dup_rows: bool = False,
+    cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
+    n_steps: int = 4,
+    n_seeds: int = 2,
+    mutate=None,
+) -> NonInterferenceReport:
+    """Prove (or refute) derived-state non-interference for one build.
+
+    ``entry="step"`` traces the single-seed step — the per-equation
+    frontier is then readable. ``entry="run"`` traces
+    ``make_run(n_steps)`` over a batched state, which routes the whole
+    proof through a vmapped ``lax.scan`` body (the loop-carry fixpoint
+    path). ``mutate`` optionally wraps the traced function (the planted
+    leak mutants use it); it receives and returns a
+    ``SimState -> SimState`` callable.
+    """
+    flags = dict(
+        layout=layout, time32=time32, dup_rows=dup_rows,
+        cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
+        cov_hitcount=cov_hitcount,
+    )
+    obs_kw = dict(
+        dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
+        timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+    )
+    init = make_init(
+        wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
+        timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+    )
+    state = init(np.zeros(max(n_seeds, 1), np.uint64))
+    if entry == "step":
+        fn = make_step(wl, cfg, layout=layout, time32=time32, **obs_kw)
+        template = jax.tree.map(lambda a: a[0], state)
+    elif entry == "run":
+        fn = make_run(
+            wl, cfg, n_steps, layout=layout, time32=time32, **obs_kw
+        )
+        template = state
+    else:
+        raise ValueError(f"unknown entry {entry!r} (step or run)")
+    if mutate is not None:
+        fn = mutate(fn)
+
+    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(template)
+    in_names = _leaf_names(template)
+    out_names = _leaf_names(out_shape)
+    derived = derived_fields(wl)
+    dset = set(derived)
+    in_taints = [
+        frozenset({name}) if name in dset else frozenset()
+        for name in in_names
+    ]
+    result = analyze_jaxpr(closed, in_taints)
+
+    out_taint = {}
+    leaks = {}
+    for i, (name, labels) in enumerate(zip(out_names, result.out_taint)):
+        if not labels:
+            continue
+        out_taint[name] = sorted(labels)
+        if name not in dset:
+            leaks[name] = {
+                "labels": sorted(labels),
+                "chain": result.leak_chain(i),
+            }
+    return NonInterferenceReport(
+        workload=wl.name,
+        config_hash=cfg.hash(),
+        entry=entry,
+        flags=flags,
+        derived=derived,
+        out_taint=out_taint,
+        leaks=leaks,
+        frontier=[r.to_dict() for r in result.frontier],
+        n_eqns=_count_eqns(closed.jaxpr),
+    )
+
+
+def _count_eqns(jaxpr) -> int:
+    n = len(jaxpr.eqns)
+    for eqn in jaxpr.eqns:
+        for key, val in eqn.params.items():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for item in vals:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    n += _count_eqns(inner)
+                elif hasattr(item, "eqns"):
+                    n += _count_eqns(item)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Planted leak mutants — the positive controls.
+# ---------------------------------------------------------------------------
+
+
+def plant_met_leak(step_fn):
+    """Wrap a step so one op reads ``met`` into the RNG cursor.
+
+    ``step + met[MET_SENT] * 0`` is **value-identical** to the clean
+    step on every input — no runtime identity test, oracle compare or
+    trace hash can ever distinguish them — yet it is a real data edge
+    from a derived column into the RNG coordinate, exactly the class of
+    bug the static proof exists to catch. Requires ``metrics=True``
+    (otherwise ``met`` is zero-size and there is nothing to read).
+    """
+    import jax.numpy as jnp
+
+    from ..engine.core import MET_SENT
+
+    def mutant(st):
+        out = step_fn(st)
+        if out.met.ndim != 1:
+            raise ValueError(
+                "plant_met_leak is a step-entry mutant: it indexes the "
+                "(N_METRICS,) met vector of ONE seed; with entry='run' "
+                "the batched (S, N_METRICS) axis would be poisoned "
+                "along the wrong dimension"
+            )
+        poison = (out.met[MET_SENT] * jnp.int32(0)).astype(jnp.uint32)
+        return dataclasses.replace(out, step=out.step + poison)
+
+    return mutant
+
+
+# ---------------------------------------------------------------------------
+# The certified matrix: four recorded models x build-flag axes.
+# ---------------------------------------------------------------------------
+
+# build-flag axes: each turns one derived-column family (or all of
+# them) on. History on/off and disk-discipline on/off are MODEL
+# variants (record= / durable=), so they live in model_matrix below.
+BUILD_AXES = {
+    "base": {},
+    "metrics": dict(metrics=True),
+    "timeline": dict(timeline_cap=8),
+    "coverage": dict(cov_words=8),
+    "hitcount": dict(cov_words=8, cov_hitcount=True),
+    "all": dict(
+        metrics=True, timeline_cap=8, cov_words=8, cov_hitcount=True,
+    ),
+}
+
+def model_matrix() -> list:
+    """(name, workload, config) triples for the four recorded models.
+
+    Each model module owns its tracing entry points
+    (``models/<name>.py lint_entries()``): every model appears with
+    history recording on AND off, and raftlog additionally with the
+    disk discipline on — the {metrics, timeline, coverage, history,
+    disk-discipline} axes the acceptance matrix sweeps (build flags
+    come from BUILD_AXES).
+    """
+    from ..models import kvchaos, paxos, raft, raftlog
+
+    entries = []
+    for mod in (raft, kvchaos, paxos, raftlog):
+        for tag, wl, cfg_kw in mod.lint_entries():
+            entries.append((tag, wl, EngineConfig(**cfg_kw)))
+    return entries
+
+
+def check_matrix(
+    models=None,
+    axes=None,
+    *,
+    entry: str = "step",
+    layout: str = "scatter",
+    log=None,
+) -> list:
+    """Run the proof over a model x build-flag matrix; returns reports.
+
+    Defaults to the full certified matrix (tools/lint_soak.py scale);
+    tests pass a slice for the tier-1 smoke.
+    """
+    if models is not None and not models:
+        # an explicitly empty slice is a caller bug (e.g. a tag filter
+        # that matched nothing) — falling back to the full matrix here
+        # would silently multiply the gate's cost instead
+        raise ValueError("check_matrix: models is empty")
+    reports = []
+    for name, wl, cfg in (models if models is not None else model_matrix()):
+        for axis, flags in (axes or BUILD_AXES).items():
+            rep = check_noninterference(
+                wl, cfg, entry=entry, layout=layout, **flags
+            )
+            rep.flags["axis"] = axis
+            if log is not None:
+                log(rep.summary())
+            reports.append(rep)
+    return reports

@@ -1,0 +1,300 @@
+"""Jaxpr taint propagation — the non-interference core.
+
+A jaxpr is a first-order dataflow program, which makes information-flow
+analysis on it almost embarrassingly direct: label some inputs with
+taint sources, and for every equation the outputs inherit the union of
+the input labels. The only real work is the higher-order primitives:
+
+* ``pjit`` / call-like primitives — recurse into the sub-jaxpr with the
+  call-site labels mapped onto its invars.
+* ``cond`` (which ``lax.switch`` lowers to) — outputs join over every
+  branch, PLUS the predicate's labels: a tainted branch index is an
+  implicit flow (which value you got depends on tainted data), and a
+  sound checker must treat it as a leak.
+* ``scan`` / ``while`` — the loop carry is a cycle, so labels iterate
+  to a fixpoint (monotone unions over a finite label set: terminates).
+  A tainted ``while`` condition taints every carry for the same
+  implicit-flow reason (the iteration count observes tainted data).
+
+Everything here is *conservative over data+control flow*: no false
+negatives by construction (an unknown primitive with a sub-jaxpr it
+cannot map falls back to all-inputs-taint-all-outputs). False positives
+are possible in principle — e.g. ``x * 0`` keeps ``x``'s labels — and
+that is exactly the property the engine's discipline needs: a
+value-identical-but-data-dependent edge from derived state into the
+trajectory is a latent leak the runtime bit-identity tests can NEVER
+see, and this analysis is the only line of defense that flags it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from jax import core as jax_core
+
+__all__ = ["TaintEqn", "TaintResult", "analyze_jaxpr"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TaintEqn:
+    """One tainted equation — a row of the isolation frontier.
+
+    ``path`` locates the equation (``eqns[12]``, or nested:
+    ``eqns[7].cond.branch0.eqns[3]``); ``sources`` are the taint labels
+    flowing in; ``mixes_clean`` marks equations that also consume at
+    least one untainted, non-literal value — the places where derived
+    state meets core data, i.e. exactly where a leak would originate if
+    the equation's results ever reached a core output.
+    """
+
+    path: str
+    prim: str
+    sources: tuple
+    mixes_clean: bool
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "prim": self.prim,
+            "sources": list(self.sources),
+            "mixes_clean": self.mixes_clean,
+        }
+
+
+@dataclasses.dataclass
+class TaintResult:
+    """Outcome of one :func:`analyze_jaxpr` pass."""
+
+    out_taint: list  # per-outvar frozenset of source labels
+    frontier: list  # list[TaintEqn], program order (tainted eqns only)
+    # top-level var -> taint labels and var -> defining eqn index, kept
+    # for leak-chain extraction (sub-jaxpr internals are summarized by
+    # their enclosing equation)
+    _env: dict
+    _defs: dict
+    _jaxpr: object  # the analyzed (closed) jaxpr
+
+    def leak_chain(self, out_index: int, max_len: int = 32) -> list:
+        """Backward slice from output ``out_index`` to a tainted input.
+
+        Returns equation descriptors (dicts) from the source end to the
+        output end — the "offending equation" trail a leak report
+        prints. Chains through sub-jaxprs stop at the enclosing
+        equation (its ``path`` names the nested location).
+        """
+        jaxpr = _unclose(self._jaxpr)
+        v = jaxpr.outvars[out_index]
+        chain = []
+        seen = set()
+        while (
+            isinstance(v, jax_core.Var)
+            and v in self._defs
+            and v not in seen
+            and len(chain) < max_len
+        ):
+            seen.add(v)
+            idx = self._defs[v]
+            eqn = jaxpr.eqns[idx]
+            in_ts = [_read(self._env, u) for u in eqn.invars]
+            chain.append(
+                {
+                    "path": f"eqns[{idx}]",
+                    "prim": eqn.primitive.name,
+                    "sources": sorted(frozenset().union(*in_ts) if in_ts else ()),
+                }
+            )
+            nxt = None
+            for u, t in zip(eqn.invars, in_ts):
+                if t and isinstance(u, jax_core.Var):
+                    nxt = u
+                    break
+            if nxt is None:
+                break
+            v = nxt
+        chain.reverse()
+        return chain
+
+
+def _unclose(j):
+    return j.jaxpr if isinstance(j, jax_core.ClosedJaxpr) else j
+
+
+def _read(env, v):
+    if isinstance(v, jax_core.Literal):
+        return frozenset()
+    return env.get(v, frozenset())
+
+
+def _sub_jaxprs(params):
+    """Every (key, ClosedJaxpr/Jaxpr) pair hiding in an eqn's params."""
+    out = []
+    for key, val in params.items():
+        if isinstance(val, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
+            out.append((key, val))
+        elif isinstance(val, (tuple, list)):
+            for i, item in enumerate(val):
+                if isinstance(item, (jax_core.Jaxpr, jax_core.ClosedJaxpr)):
+                    out.append((f"{key}[{i}]", item))
+    return out
+
+
+def _propagate(jaxpr, in_taints, path, rows, defs=None, env_out=None):
+    """Forward-propagate taint through one (open) jaxpr.
+
+    ``rows`` collects TaintEqn frontier entries (pass a throwaway list
+    to analyze silently — the fixpoint loops do, then re-run once
+    converged so each equation reports exactly once). ``defs``/
+    ``env_out``: optional dicts filled with var->eqn-index and
+    var->labels for the chain extractor (top level only).
+    """
+    env = {}
+    for v, t in zip(jaxpr.invars, in_taints):
+        env[v] = frozenset(t)
+    for v in jaxpr.constvars:
+        env[v] = frozenset()
+
+    for idx, eqn in enumerate(jaxpr.eqns):
+        in_ts = [_read(env, v) for v in eqn.invars]
+        union = frozenset().union(*in_ts) if in_ts else frozenset()
+        name = eqn.primitive.name
+        epath = f"{path}eqns[{idx}]"
+        n_out = len(eqn.outvars)
+        out_ts = None
+
+        if name == "cond":
+            # lax.cond/switch: invars[0] is the branch index. Implicit
+            # flow: a tainted index taints every output.
+            branches = eqn.params["branches"]
+            pred_t = in_ts[0]
+            op_ts = in_ts[1:]
+            per_branch = []
+            for bi, br in enumerate(branches):
+                per_branch.append(
+                    _call_sub(br, op_ts, f"{epath}.branch{bi}.", rows)
+                )
+            out_ts = [
+                frozenset(pred_t).union(*[b[i] for b in per_branch])
+                for i in range(n_out)
+            ]
+        elif name == "while":
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            cond_j = eqn.params["cond_jaxpr"]
+            body_j = eqn.params["body_jaxpr"]
+            cconst = in_ts[:cn]
+            bconst = in_ts[cn : cn + bn]
+            carry = list(in_ts[cn + bn :])
+            scratch = []
+            while True:
+                pred_t = _call_sub(
+                    cond_j, cconst + carry, f"{epath}.cond.", scratch
+                )[0]
+                body_out = _call_sub(
+                    body_j, bconst + carry, f"{epath}.body.", scratch
+                )
+                # implicit flow: the iteration count observes the
+                # condition, so its labels reach every carried value
+                new_carry = [
+                    c | o | pred_t for c, o in zip(carry, body_out)
+                ]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            # converged: re-run once so the frontier reports each body
+            # equation exactly once, at the fixpoint labels
+            _call_sub(cond_j, cconst + carry, f"{epath}.cond.", rows)
+            _call_sub(body_j, bconst + carry, f"{epath}.body.", rows)
+            out_ts = carry
+        elif name == "scan":
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            body = eqn.params["jaxpr"]
+            consts = in_ts[:nc]
+            carry = list(in_ts[nc : nc + ncar])
+            xs = in_ts[nc + ncar :]
+            ys = [frozenset() for _ in range(n_out - ncar)]
+            scratch = []
+            while True:
+                outs = _call_sub(
+                    body, consts + carry + xs, f"{epath}.body.", scratch
+                )
+                new_carry = [c | o for c, o in zip(carry, outs[:ncar])]
+                ys = [y | o for y, o in zip(ys, outs[ncar:])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            final = _call_sub(
+                body, consts + carry + xs, f"{epath}.body.", rows
+            )
+            ys = [y | o for y, o in zip(ys, final[ncar:])]
+            out_ts = carry + ys
+        else:
+            subs = _sub_jaxprs(eqn.params)
+            if len(subs) == 1:
+                # pjit / closed_call / remat / custom_* — a plain call
+                # whose sub-jaxpr invars line up with the eqn invars
+                key, sub = subs[0]
+                n_sub_in = len(_unclose(sub).invars)
+                if n_sub_in == len(in_ts):
+                    out_ts = _call_sub(sub, in_ts, f"{epath}.{name}.", rows)
+                    if len(out_ts) > n_out:
+                        # custom_vjp-style extras: keep the leading ones
+                        out_ts = out_ts[:n_out]
+                    elif len(out_ts) < n_out:
+                        out_ts = None  # shape surprise: fall through
+            if out_ts is None:
+                # first-order primitive — or a higher-order shape this
+                # walker doesn't know: all inputs taint all outputs
+                # (conservative, never unsound)
+                out_ts = [union] * n_out
+
+        if union:
+            rows.append(
+                TaintEqn(
+                    path=epath,
+                    prim=name,
+                    sources=tuple(sorted(union)),
+                    mixes_clean=any(
+                        (not t) and isinstance(v, jax_core.Var)
+                        for v, t in zip(eqn.invars, in_ts)
+                    ),
+                )
+            )
+        for v, t in zip(eqn.outvars, out_ts):
+            env[v] = t
+            if defs is not None and isinstance(v, jax_core.Var):
+                defs[v] = idx
+
+    if env_out is not None:
+        env_out.update(env)
+    return [_read(env, v) for v in jaxpr.outvars]
+
+
+def _call_sub(sub, in_ts, path, rows):
+    jaxpr = _unclose(sub)
+    return _propagate(jaxpr, in_ts, path, rows)
+
+
+def analyze_jaxpr(closed, in_taints) -> TaintResult:
+    """Propagate ``in_taints`` (one label set per invar) through a
+    (closed) jaxpr and return per-outvar label sets plus the tainted-
+    equation frontier."""
+    jaxpr = _unclose(closed)
+    if len(in_taints) != len(jaxpr.invars):
+        raise ValueError(
+            f"{len(in_taints)} taint sets for {len(jaxpr.invars)} invars"
+        )
+    rows: list = []
+    defs: dict = {}
+    env: dict = {}
+    out = _propagate(
+        jaxpr,
+        [frozenset(t) for t in in_taints],
+        "",
+        rows,
+        defs=defs,
+        env_out=env,
+    )
+    return TaintResult(
+        out_taint=out, frontier=rows, _env=env, _defs=defs, _jaxpr=closed
+    )

@@ -370,3 +370,14 @@ def make_kvchaos(
         payload_words=2 if payload else 0,
         history=hist,
     )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint); the payload variant rides along so the proof
+    covers the payload-arena trace fold too."""
+    kw = dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("kvchaos/plain", make_kvchaos(), kw),
+        ("kvchaos/record", make_kvchaos(record=True, payload=True), kw),
+    ]

@@ -330,3 +330,13 @@ def make_paxos(
         # overflow is loud (hist_drop) + quarantined by search_seeds
         history=HistorySpec(capacity=32, max_records=1) if record else None,
     )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint)."""
+    kw = dict(pool_size=48, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("paxos/plain", make_paxos(), kw),
+        ("paxos/record", make_paxos(record=True), kw),
+    ]

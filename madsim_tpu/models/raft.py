@@ -165,3 +165,15 @@ def make_raft(
         # bound recorded events at a handful; 8 slots is generous
         history=HistorySpec(capacity=8, max_records=1) if record else None,
     )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint): (tag, workload, engine-config kwargs) — the
+    history on/off axis of the proof lives here, in the model's own
+    recorded/plain variants."""
+    kw = dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("raft/plain", make_raft(), kw),
+        ("raft/record", make_raft(record=True), kw),
+    ]

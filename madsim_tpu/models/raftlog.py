@@ -149,7 +149,17 @@ def make_raftlog(
     With ``record=True`` and ``durable=True`` the model additionally
     records ``OP_SYNCED`` (a committed log-length change) and
     ``OP_RECOVER`` (the length a restarted node came back with) events
-    for ``check.recovery_safety``."""
+    for ``check.recovery_safety``.
+
+    ``durable=True`` handlers are also **EIO-aware**: inside an
+    injected observable fsync-failure window (``chaos.DiskFault``
+    ``n_eio``, surfaced as ``ctx.sync_err`` — the batched
+    ``FsSim.set_fail_writes``) a node withholds every externally
+    visible durability promise — candidacy, vote grants, append acks,
+    proposals — and retries after the window, so correctness holds
+    under EIO storms by design. All the gates read a flag that is
+    constant False on fault-free runs, keeping those trajectories (and
+    the oracle compare) bit-identical."""
     if bug not in (None, "nosync"):
         raise ValueError(f"unknown raftlog bug {bug!r} (only 'nosync')")
     if bug and not durable:
@@ -222,9 +232,29 @@ def make_raftlog(
         new = ctx.state.at[TSEQ].set(1)
         return new, eb.build()
 
+    def _eio(ctx):
+        """The node's observable fsync-EIO bit (chaos DiskFault n_eio).
+
+        Constant False outside an injected EIO window — and for the
+        diskless / nosync variants — so every ``& ~_eio`` gate below is
+        value-identical to the ungated model on fault-free runs (the
+        oracle compare stays exact). A correct node's rule: never make
+        an externally visible durability promise (candidacy, vote
+        grant, append ack, proposal) while fsync is failing; retry
+        after the window.
+        """
+        if sync_en and ctx.sync_err is not None:
+            return ctx.sync_err
+        return jnp.asarray(False)
+
     def on_timeout(ctx):
         st = ctx.state
-        fire = (ctx.args[0] == st[TSEQ]) & (st[ROLE] != jnp.int32(LEADER))
+        due = (ctx.args[0] == st[TSEQ]) & (st[ROLE] != jnp.int32(LEADER))
+        err = _eio(ctx)
+        # a node whose disk is failing cannot persist its candidacy
+        # (votedFor=self): it skips this election and re-arms the SAME
+        # timer seq so the timeout retries after the window
+        fire = due & ~err
         term = st[TERM] + 1
         new = jnp.where(
             fire,
@@ -241,6 +271,7 @@ def make_raftlog(
                 when=fire & (jnp.int32(p) != ctx.node),
             )
         _arm_election(ctx, eb, st[TSEQ] + 1, fire)
+        _arm_election(ctx, eb, st[TSEQ], due & err)
         if sync_en:
             # currentTerm/votedFor changed: fsync before the vote
             # requests leave (Figure 2's persist-before-respond rule)
@@ -257,10 +288,16 @@ def make_raftlog(
             st.at[TERM].set(term).at[ROLE].set(FOLLOWER).at[VOTES].set(0),
             st,
         )
-        # the up-to-date rule: candidate's (last term, length) >= ours
+        # the up-to-date rule: candidate's (last term, length) >= ours.
+        # A failing disk (EIO window) withholds the grant — a vote that
+        # cannot be persisted must not be promised; the candidate's
+        # retransmitted request after the window can still win it.
         my_lt = _lastterm(st1)
         up_to_date = (c_lt > my_lt) | ((c_lt == my_lt) & (c_len >= st1[LOGLEN]))
-        grant = (term == st1[TERM]) & (st1[VOTED] < term) & up_to_date
+        grant = (
+            (term == st1[TERM]) & (st1[VOTED] < term) & up_to_date
+            & ~_eio(ctx)
+        )
         new = jnp.where(
             grant, st1.at[VOTED].set(term).at[TSEQ].set(st1[TSEQ] + 1), st1
         )
@@ -279,7 +316,9 @@ def make_raftlog(
         term = ctx.args[0]
         counts = (st[ROLE] == jnp.int32(CANDIDATE)) & (term == st[TERM])
         votes = jnp.where(counts, st[VOTES] + 1, st[VOTES])
-        wins = counts & (votes >= jnp.int32(majority))
+        # a candidate whose disk is failing defers leadership: the
+        # win-time re-stamp must be persisted before re-replication
+        wins = counts & (votes >= jnp.int32(majority)) & ~_eio(ctx)
         new = st.at[VOTES].set(votes)
         new = jnp.where(wins, new.at[ROLE].set(LEADER), new)
         # win-time re-stamp: uncommitted suffix takes the new term (the
@@ -337,8 +376,14 @@ def make_raftlog(
             ok, new.at[COMMIT].set(jnp.maximum(new[COMMIT], l_commit)), new
         )
         eb = ctx.emits()
+        # EIO window: the entries were adopted in RAM but the fsync
+        # will fail — withhold the ack (acking would be exactly the
+        # acked-before-durable bug); the leader's retransmission after
+        # the window re-adopts at the same idx and acks then
+        err = _eio(ctx)
         eb.send(
-            leader, user_kind(_H_ACKAPP), (term, idx, ctx.node), when=adopt
+            leader, user_kind(_H_ACKAPP), (term, idx, ctx.node),
+            when=adopt & ~err,
         )
         # a heartbeat resets the election timer
         _arm_election(ctx, eb, st[TSEQ] + 1, ok)
@@ -349,10 +394,19 @@ def make_raftlog(
             eb.sync(when=ok)
         if rec_store and sync_en:
             # a committed log-length change (adoptions that merely
-            # re-install the same length are not length events)
+            # re-install the same length are not length events). Under
+            # an EIO window the sync did NOT commit — recording it
+            # would teach recovery_safety a floor the disk never held.
+            # The converse case is accepted conservatism: entries first
+            # adopted INSIDE a window get their committing sync on a
+            # same-length re-adopt after it, which this gate skips, so
+            # the detector's floor can sit below the true synced state
+            # (it misses nothing falsely, it just under-floors; a
+            # per-node "unsynced adopt" flag would fix it but would
+            # widen the state row the C++ oracle pins bit-for-bit)
             eb.record(
                 OP_SYNCED, key=0, arg=idx + 1,
-                when=adopt & (idx + jnp.int32(1) != st[LOGLEN]),
+                when=adopt & ~err & (idx + jnp.int32(1) != st[LOGLEN]),
             )
         return new, eb.build()
 
@@ -395,9 +449,12 @@ def make_raftlog(
         st = ctx.state
         term = ctx.args[0]
         alive_leader = (st[ROLE] == jnp.int32(LEADER)) & (term == st[TERM])
+        # a leader with a failing disk does not propose (it pre-counts
+        # its own ack below, which is a durability promise); the
+        # propose timer re-arms via alive_leader, so it retries
         can = alive_leader & (st[COMMIT] == st[LOGLEN]) & (
             st[LOGLEN] < jnp.int32(w)
-        )
+        ) & ~_eio(ctx)
         value = (ctx.draw.user(_P_VALUE) & jnp.uint32(0xFF)).astype(jnp.int32)
         entry = value | (st[TERM] << jnp.int32(8))
         new = st
@@ -482,3 +539,18 @@ def make_raftlog(
             else None
         ),
     )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint). The durable variant is the disk-discipline-ON
+    axis: the storage columns become core there (a crash reads the
+    disk image back into node_state) and ``engine.derived_fields``
+    reclassifies them — the proof then covers the remaining derived
+    set."""
+    kw = dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("raftlog/plain", make_raftlog(), kw),
+        ("raftlog/record", make_raftlog(record=True), kw),
+        ("raftlog/durable", make_raftlog(durable=True, record=True), kw),
+    ]

@@ -38,7 +38,7 @@ from ..engine.core import (  # noqa: F401 — the slot layout obs consumes
 )
 from .metrics import FleetMetrics, fleet_metrics, fleet_reduce  # noqa: F401
 from .perfetto import to_perfetto, write_perfetto  # noqa: F401
-from .telemetry import JsonlSink, explain  # noqa: F401
+from .telemetry import JsonlSink, explain, explain_diff  # noqa: F401
 from .timeline import (  # noqa: F401
     decode_timeline,
     refold_timeline,
@@ -52,6 +52,7 @@ __all__ = [
     "N_METRICS",
     "decode_timeline",
     "explain",
+    "explain_diff",
     "fleet_metrics",
     "fleet_reduce",
     "refold_timeline",

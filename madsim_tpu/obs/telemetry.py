@@ -36,7 +36,7 @@ from ..engine.core import (
 )
 from .timeline import decode_timeline
 
-__all__ = ["JsonlSink", "explain"]
+__all__ = ["JsonlSink", "explain", "explain_diff"]
 
 
 class JsonlSink:
@@ -92,6 +92,51 @@ def _plan_rows_for(plan, seed):
     return stack_plan_rows([lit]), lit.slots, lit.uses_dup(), lit
 
 
+# compiled-run cache: explain/explain_diff re-runs over the same
+# (workload, config, caps) — a diff is two captures, a forensics
+# session many — reuse the XLA program instead of re-tracing per call
+# (the engine.search._RUN_CACHE pattern: jit keys on function identity,
+# so a fresh make_run_while closure per capture would defeat it).
+# Keyed on id(wl) like that cache (workload closures aren't hashable),
+# so hold ONE workload object across captures to hit it; bounded FIFO
+# so a sweep over many (wl, cfg) pairs cannot grow memory unboundedly.
+_CAPTURE_CACHE: dict = {}
+_CAPTURE_CACHE_MAX = 8
+
+
+def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout):
+    """Re-run one (seed, plan) with the forensics taps on: a field-name
+    view dict of the final state plus the literalized plan (or None)."""
+    seeds = np.asarray([seed], np.uint64)
+    if plan is not None:
+        rows, slots, dup, lit = _plan_rows_for(plan, seed)
+    else:
+        rows, slots, dup, lit = None, 0, False, None
+    key = (id(wl), cfg.hash(), max_steps, timeline_cap, layout, slots, dup)
+    if key not in _CAPTURE_CACHE:
+        while len(_CAPTURE_CACHE) >= _CAPTURE_CACHE_MAX:
+            _CAPTURE_CACHE.pop(next(iter(_CAPTURE_CACHE)))
+        _CAPTURE_CACHE[key] = (
+            make_init(
+                wl, cfg, plan_slots=slots, metrics=True,
+                timeline_cap=timeline_cap,
+            ),
+            jax.jit(make_run_while(
+                wl, cfg, max_steps, layout=layout, dup_rows=dup,
+                metrics=True, timeline_cap=timeline_cap,
+            )),
+            wl,  # keep the workload alive so id() stays unique
+        )
+    init, run, _wl = _CAPTURE_CACHE[key]
+    state = init(seeds, rows) if rows is not None else init(seeds)
+    out = jax.block_until_ready(run(state))
+    view = {
+        f.name: np.asarray(getattr(out, f.name))
+        for f in dataclasses.fields(out)
+    }
+    return view, lit
+
+
 def explain(
     wl,
     cfg,
@@ -114,24 +159,7 @@ def explain(
     ``max_events`` bounds the printed timeline (the middle is elided;
     the head establishes context, the tail holds the crash site).
     """
-    seeds = np.asarray([seed], np.uint64)
-    if plan is not None:
-        rows, slots, dup, lit = _plan_rows_for(plan, seed)
-    else:
-        rows, slots, dup, lit = None, 0, False, None
-    init = make_init(
-        wl, cfg, plan_slots=slots, metrics=True, timeline_cap=timeline_cap
-    )
-    run = jax.jit(make_run_while(
-        wl, cfg, max_steps, layout=layout, dup_rows=dup,
-        metrics=True, timeline_cap=timeline_cap,
-    ))
-    state = init(seeds, rows) if rows is not None else init(seeds)
-    out = jax.block_until_ready(run(state))
-    view = {
-        f.name: np.asarray(getattr(out, f.name))
-        for f in dataclasses.fields(out)
-    }
+    view, lit = _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout)
 
     lines = [
         f"=== explain: {wl.name!r} seed {int(seed)} "
@@ -185,13 +213,7 @@ def explain(
         if tag == "gap":
             lines.append(f"    ... {item} rows elided ...")
         elif tag == "ev":
-            e = item
-            origin = "timer" if e.src < 0 else f"node{e.src}"
-            argstr = ",".join(str(a) for a in e.args)
-            lines.append(
-                f"  [{e.time_ns / 1e6:>10.3f}ms] node{e.node} <- "
-                f"{e.kind_name(wl)}({argstr}) from {origin}"
-            )
+            lines.append(f"  {_fmt_event(item, wl)}")
         else:
             t, (op, key, arg, client, ok) = item
             lines.append(
@@ -241,4 +263,136 @@ def explain(
         + (f" plan_hash={lit.hash()}" if lit is not None else "")
         + f" trace={int(view['trace'][0]):#018x}"
     )
+    return "\n".join(lines)
+
+
+def _fmt_event(e, wl) -> str:
+    origin = "timer" if e.src < 0 else f"node{e.src}"
+    argstr = ",".join(str(a) for a in e.args)
+    return (
+        f"[{e.time_ns / 1e6:>10.3f}ms] node{e.node} <- "
+        f"{e.kind_name(wl)}({argstr}) from {origin}"
+    )
+
+
+def _row_key(e) -> tuple:
+    return (e.time_ns, e.kind, e.node, e.src, tuple(e.args), tuple(e.pay))
+
+
+def explain_diff(
+    wl,
+    cfg,
+    clean,
+    violating,
+    invariant=None,
+    history_invariant=None,
+    max_steps: int = 1000,
+    timeline_cap: int = 1024,
+    layout: str | None = None,
+    context: int = 6,
+) -> str:
+    """Localize where a violating run departs from a clean sibling.
+
+    ``clean`` / ``violating`` are ``(seed, plan)`` pairs (plan None for
+    a bare seeded run) — typically two children of the same corpus
+    parent, one admitted clean and one violating (``explore``'s
+    frontier breeding makes such siblings abundant). Both are re-run
+    with the timeline ring on; the narrative prints the **first
+    divergent timeline row** (compared over the captured ``tl_t`` /
+    ``tl_meta`` / ``tl_args`` / ``tl_pay`` columns — the exact tuples
+    the trace hash folds, so "row k diverges" is a certified
+    statement, not a heuristic), a window of common context before it,
+    and each side's continuation plus verdict. Identical streams are
+    reported as such — then the divergence is in final state only.
+    """
+    (seed_a, plan_a), (seed_b, plan_b) = clean, violating
+    view_a, lit_a = _capture(
+        wl, cfg, seed_a, plan_a, max_steps, timeline_cap, layout
+    )
+    view_b, lit_b = _capture(
+        wl, cfg, seed_b, plan_b, max_steps, timeline_cap, layout
+    )
+    ev_a = decode_timeline(view_a, wl, 0)
+    ev_b = decode_timeline(view_b, wl, 0)
+
+    def _key(side, seed, lit):
+        return (
+            f"seed={int(seed)}"
+            + (f" plan={lit.hash()}" if lit is not None else "")
+            + f" trace={int(side['trace'][0]):#018x}"
+        )
+
+    lines = [
+        f"=== explain-diff: {wl.name!r} config_hash={cfg.hash()}",
+        f"    clean:     {_key(view_a, seed_a, lit_a)}",
+        f"    violating: {_key(view_b, seed_b, lit_b)}",
+    ]
+    for tag, lit in (("clean", lit_a), ("violating", lit_b)):
+        if lit is not None:
+            on = [e for e, m in zip(lit.events, lit._mask()) if m]
+            lines.append(f"--- {tag} plan ({len(on)} events):")
+            lines.extend(f"    {e}" for e in on)
+
+    div = None
+    for i in range(min(len(ev_a), len(ev_b))):
+        if _row_key(ev_a[i]) != _row_key(ev_b[i]):
+            div = i
+            break
+    if div is None and len(ev_a) != len(ev_b):
+        div = min(len(ev_a), len(ev_b))
+
+    for side in (view_a, view_b):
+        if int(side["tl_drop"][0]):
+            lines.append(
+                f"    WARNING: {int(side['tl_drop'][0])} event(s) dropped "
+                f"at ring capacity — divergence index is prefix-only"
+            )
+
+    if div is None:
+        lines.append(
+            f"--- timelines IDENTICAL over {len(ev_a)} dispatched events "
+            f"(divergence, if any, is outside the captured stream)"
+        )
+    else:
+        lines.append(
+            f"--- first divergent timeline row: {div} "
+            f"(of {len(ev_a)} clean / {len(ev_b)} violating events)"
+        )
+        lo = max(div - context, 0)
+        if lo > 0:
+            lines.append(f"    ... {lo} identical rows elided ...")
+        for i in range(lo, div):
+            lines.append(f"    ={i:>5}  {_fmt_event(ev_a[i], wl)}")
+        for tag, evs in (("clean", ev_a), ("violating", ev_b)):
+            lines.append(f"  {tag} continues:")
+            if div >= len(evs):
+                lines.append("        (stream ends)")
+            for i in range(div, min(div + context, len(evs))):
+                lines.append(f"    {tag[0]}{i:>5}  {_fmt_event(evs[i], wl)}")
+
+    for tag, side in (("clean", view_a), ("violating", view_b)):
+        met = side["met"][0]
+        code = int(met[MET_HALT_CODE])
+        lines.append(
+            f"--- {tag} outcome: "
+            f"{_HALT_STORY.get(code, f'halt code {code}')}"
+        )
+        verdicts = []
+        if invariant is not None:
+            verdicts.append(
+                ("final-state invariant", bool(np.asarray(invariant(side))[0]))
+            )
+        if history_invariant is not None:
+            from ..check.history import BatchHistory
+
+            verdicts.append((
+                "history invariant",
+                bool(np.asarray(
+                    history_invariant(BatchHistory.from_view(side))
+                )[0]),
+            ))
+        for what, ok in verdicts:
+            lines.append(
+                f"    {what}: {'HOLDS' if ok else 'VIOLATED'}"
+            )
     return "\n".join(lines)

@@ -399,7 +399,9 @@ def deterministic_as_completed(fs, *, timeout: Optional[float] = None):
     seen: set = set()
     todo: list = []
     for f in fs:
-        if id(f) in seen:
+        # identity-dedup replicates set(fs) EQUALITY semantics; the
+        # address value never orders anything (spawn stays input-order)
+        if id(f) in seen:  # lint: allow(id-hash-branch)
             continue
         seen.add(id(f))
         todo.append(_aio.ensure_future(f, loop=loop))

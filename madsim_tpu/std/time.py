@@ -21,7 +21,7 @@ async def sleep(seconds: float) -> None:
 
 
 async def sleep_until(deadline_s: float) -> None:
-    await asyncio.sleep(max(0.0, deadline_s - _time.monotonic()))
+    await asyncio.sleep(max(0.0, deadline_s - _time.monotonic()))  # lint: allow(wall-clock)
 
 
 async def timeout(seconds: float, awaitable):
@@ -32,8 +32,8 @@ async def timeout(seconds: float, awaitable):
 
 
 def now() -> float:
-    return _time.monotonic()
+    return _time.monotonic()  # lint: allow(wall-clock)
 
 
 def now_ns() -> int:
-    return _time.monotonic_ns()
+    return _time.monotonic_ns()  # lint: allow(wall-clock)

@@ -92,13 +92,13 @@ def lin_sweep(h, n_cap=None) -> list:
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     cfg = EngineConfig(pool_size=192, loss_p=0.05)
-    t_all = time.monotonic()
+    t_all = time.monotonic()  # lint: allow(wall-clock)
     failures = []
     print(f"# operation-history checker soak: {n_seeds} schedules/cert, "
           f"platform={jax.devices()[0].platform}")
 
     # ---- certificate 1: unmutated kvchaos, history clean ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
     rep = search_seeds(
         make_kvchaos(writes=W, record=True), cfg, None,
@@ -110,7 +110,7 @@ def main() -> None:
     nl = len(lin_sweep(h))
     no = int(rep.overflowed.sum())
     nh = int((~np.asarray(rep.halted)).sum())
-    t_lin = time.monotonic() - t0
+    t_lin = time.monotonic() - t0  # lint: allow(wall-clock)
     print(f"kvchaos-record: {n_seeds} schedules, {nv} vectorized "
           f"violations, {nl} linearizability violations, {no} overflows, "
           f"{nh} unhalted ({t_lin:.1f}s incl. {n_seeds} Wing-Gong checks)")
@@ -118,7 +118,7 @@ def main() -> None:
         failures.append("kvchaos-record")
 
     # ---- certificate 2: raft election safety over recorded wins ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def elect_inv(h):
@@ -135,12 +135,12 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"raft-record: {n_seeds} schedules, {nv} election-safety "
           f"violations, {no} overflows, {nh} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("raft-record")
 
     # ---- certificate 4: raftlog election safety + log agreement ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def raftlog_inv(h):
@@ -161,12 +161,12 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"raftlog-record: {n_seeds} schedules, {nv} election/log-"
           f"agreement violations, {no} overflows, {nh} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("raftlog-record")
 
     # ---- certificate 5: paxos agreement over decide events ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def paxos_inv(h):
@@ -183,14 +183,14 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"paxos-record: {n_seeds} schedules, {nv} agreement "
           f"violations, {no} overflows, {nh} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("paxos-record")
 
     # ---- certificate 3: the lost-write mutant ----
     # flagged by the history checkers, passed by the final-state
     # invariant: the bug class the old subsystem provably cannot see
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
     fbox = {}
 
@@ -229,7 +229,7 @@ def main() -> None:
     print(f"kvchaos-bug mutant: {n_seeds} schedules, {n_hist} caught by "
           f"history check ({n_lin} confirmed by Wing-Gong), {n_final} "
           f"caught by final-state invariant, {nh3} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if n_hist:
         print(f"  first flagged seeds: {rep_h.seeds[caught][:5].tolist()}")
     if n_hist == 0:
@@ -248,7 +248,7 @@ def main() -> None:
     verdict = "PASS" if not failures else f"FAIL ({', '.join(failures)})"
     print(f"# verdict: {verdict} — history checkers catch the lost-write "
           f"bug class; final-state invariants do not")
-    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")  # lint: allow(wall-clock)
     sys.exit(1 if failures else 0)
 
 

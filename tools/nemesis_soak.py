@@ -163,14 +163,14 @@ def kv_hinv(box):
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     cfg = EngineConfig(pool_size=192, loss_p=0.05)
-    t_all = time.monotonic()
+    t_all = time.monotonic()  # lint: allow(wall-clock)
     failures = []
     print(f"# nemesis soak: {n_seeds} schedules/cert, "
           f"platform={jax.devices()[0].platform}")
     print(f"# kv plan {KV_PLAN.hash()}: {KV_PLAN.specs}")
 
     # ---- certificate 1: chaos amplification on the lost-write mutant ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
     rep_b = search_seeds(
         make_kvchaos(writes=W, record=True, bug=True), cfg, None,
@@ -180,9 +180,9 @@ def main() -> None:
     nh_b = int((~np.asarray(rep_b.halted)).sum())
     print(f"built-in schedule: {n_builtin} lost-write catches / {n_seeds}, "
           f"{int(rep_b.overflowed.sum())} overflows, {nh_b} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
 
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
     wl_bug = make_kvchaos(writes=W, record=True, bug=True, chaos=False)
     rep_n = search_seeds(
@@ -194,7 +194,7 @@ def main() -> None:
     nh_n = int((~np.asarray(rep_n.halted)).sum())
     print(f"nemesis plan:      {n_nemesis} lost-write catches / {n_seeds}, "
           f"{int(rep_n.overflowed.sum())} overflows, {nh_n} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     amp = n_nemesis / max(n_builtin, 1)
     print(f"amplification: {n_nemesis} vs {n_builtin} ({amp:.2f}x)")
     if n_nemesis <= n_builtin:
@@ -203,7 +203,7 @@ def main() -> None:
         failures.append("nemesis-mutant-unhalted")
 
     # ---- certificate 2: the clean model under the same plan ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
     rep_c = search_seeds(
         make_kvchaos(writes=W, record=True, chaos=False), cfg, None,
@@ -214,12 +214,12 @@ def main() -> None:
     no = int(rep_c.overflowed.sum())
     nh = int((~np.asarray(rep_c.halted)).sum())
     print(f"clean model, same plan: {nv} violations, {no} overflows, "
-          f"{nh} unhalted ({time.monotonic() - t0:.1f}s)")
+          f"{nh} unhalted ({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("clean-model-flagged")
 
     # ---- certificate 3: shrink a failing plan + exact replay ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     if n_nemesis == 0:
         failures.append("nothing-to-shrink")
     else:
@@ -246,14 +246,14 @@ def main() -> None:
         )
         print(f"shrink: {res.original_events} -> {len(res.events)} events, "
               f"replay identical violation + trace: {replay_ok} "
-              f"({time.monotonic() - t0:.1f}s)")
+              f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
         if len(res.events) > 4:
             failures.append("shrink-above-4-events")
         if not replay_ok:
             failures.append("shrunk-replay-diverged")
 
     # ---- certificate 4: raftlog under a nemesis plan ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def raft_inv(h):
@@ -274,7 +274,7 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"durable raftlog under nemesis ({RAFT_PLAN.hash()}): {nv} "
           f"election/log-agreement violations, {no} overflows, "
-          f"{nh} unhalted ({time.monotonic() - t0:.1f}s)")
+          f"{nh} unhalted ({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no:
         failures.append("raftlog-nemesis")
     if nh:
@@ -283,7 +283,7 @@ def main() -> None:
     # ---- certificate 5: raft election under a pause-storm plan ----
     # pauses hold events without wiping votedFor (the state kills would
     # wipe), so at-most-one-winner-per-term must hold exactly
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def relect_inv(h):
@@ -301,12 +301,12 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"raft election under nemesis ({RAFT_EL_PLAN.hash()}): {nv} "
           f"election-safety violations, {no} overflows, {nh} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("raft-election-nemesis")
 
     # ---- certificate 6: paxos agreement under a proposer crash storm ----
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def paxos_inv(h):
@@ -324,7 +324,7 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"paxos under nemesis ({PAXOS_PLAN.hash()}): {nv} agreement "
           f"violations, {no} overflows, {nh} unhalted "
-          f"({time.monotonic() - t0:.1f}s)")
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no or nh:
         failures.append("paxos-nemesis")
 
@@ -332,7 +332,7 @@ def main() -> None:
     # liveness is deliberately NOT asserted (docstring: without the
     # built-in chaos hook the coordinator has no loss-free RESYNC, so a
     # crash-after-ack can stall); atomicity must hold regardless
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # lint: allow(wall-clock)
     box = {}
 
     def tp_inv(h):
@@ -350,7 +350,7 @@ def main() -> None:
     nh = int((~np.asarray(rep.halted)).sum())
     print(f"twophase under nemesis ({TP_PLAN.hash()}): {nv} atomicity "
           f"violations, {no} overflows, {nh} unhalted (liveness not "
-          f"asserted) ({time.monotonic() - t0:.1f}s)")
+          f"asserted) ({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     if nv or no:
         failures.append("twophase-nemesis")
 
@@ -358,7 +358,7 @@ def main() -> None:
     print(f"# verdict: {verdict} — declarative nemesis amplifies chaos, "
           f"keeps clean models clean, and shrinks failures to minimal "
           f"replayable plans")
-    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")  # lint: allow(wall-clock)
     sys.exit(1 if failures else 0)
 
 

@@ -70,13 +70,13 @@ CONFIGS = [
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     total_bad = 0
-    t_all = time.monotonic()
+    t_all = time.monotonic()  # lint: allow(wall-clock)
     print(f"# oracle soak: {n_seeds} seeds x {len(CONFIGS)} configs, "
           f"platform={jax.devices()[0].platform}")
     for name, factory, cfg_kw, steps, okw in CONFIGS:
         wl, cfg = factory(), EngineConfig(**cfg_kw)
         seeds = np.arange(n_seeds, dtype=np.uint64)
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # lint: allow(wall-clock)
         out = jax.block_until_ready(
             jax.jit(make_run(wl, cfg, steps))(make_init(wl, cfg)(seeds))
         )
@@ -98,9 +98,9 @@ def main() -> None:
         total_bad += bad
         verdict = "IDENTICAL" if bad == 0 else f"{bad} DIVERGED"
         print(f"{name}: {n_seeds} seeds {verdict} "
-              f"({time.monotonic() - t0:.1f}s)")
+              f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
     print(f"# total divergences: {total_bad} "
-          f"({time.monotonic() - t_all:.0f}s wall)")
+          f"({time.monotonic() - t_all:.0f}s wall)")  # lint: allow(wall-clock)
     sys.exit(1 if total_bad else 0)
 
 

@@ -155,12 +155,12 @@ SOAKS = [
 
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
-    t_all = time.monotonic()
+    t_all = time.monotonic()  # lint: allow(wall-clock)
     worst = 0
     print(f"# chaos-search soak: {n_seeds} schedules/family, "
           f"platform={jax.devices()[0].platform}")
     for name, factory, cfg_kw, steps, inv in SOAKS:
-        t0 = time.monotonic()
+        t0 = time.monotonic()  # lint: allow(wall-clock)
         rep = search_seeds(
             factory(), EngineConfig(**cfg_kw), inv,
             n_seeds=n_seeds, max_steps=steps, compact=True,
@@ -173,10 +173,10 @@ def main() -> None:
         worst = max(worst, nv, no, nh)
         print(f"{name}: {n_seeds} schedules, {nv} violations, "
               f"{no} overflows, {nh} unhalted "
-              f"({time.monotonic() - t0:.1f}s)")
+              f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
         if nv:
             print(f"  first failing seeds: {rep.failing_seeds[:5].tolist()}")
-    print(f"# done in {time.monotonic() - t_all:.0f}s wall")
+    print(f"# done in {time.monotonic() - t_all:.0f}s wall")  # lint: allow(wall-clock)
     sys.exit(1 if worst else 0)
 
 

@@ -25,7 +25,7 @@ SHELL   := /bin/bash
 
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
-        store-soak lint lint-soak clean
+        store-soak latency-soak lint lint-soak clean
 
 check: native lint test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -111,6 +111,15 @@ obs-soak:
 STORE_SEEDS ?= 2048
 store-soak: native
 	$(PY) tools/store_soak.py $(STORE_SEEDS)
+
+# Tail-latency soak (madsim_tpu.obs latency): latency-off identity,
+# sketch exactness (fleet sketch == exact bucketing, quantiles within
+# one bucket), the clean-vs-GrayFailure p99 blowup, the guided SLO hunt
+# beating uniform at equal budget, and find->shrink->replay->explain on
+# the breach. 2048 is the evidence-artifact scale (LATENCY_r12.txt).
+LATENCY_SEEDS ?= 2048
+latency-soak:
+	$(PY) tools/latency_soak.py $(LATENCY_SEEDS)
 
 # Session-start TPU capture: the TPU tunnel historically wedges
 # mid-session, so grab the round's accelerator numbers FIRST (same

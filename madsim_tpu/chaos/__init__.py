@@ -29,6 +29,7 @@ is a layer:
 """
 
 from .plan import (  # noqa: F401
+    ClientArmy,
     ClockSkew,
     CrashStorm,
     DiskFault,
@@ -48,6 +49,7 @@ from .nemesis import Nemesis  # noqa: F401
 from .shrink import ShrinkResult, shrink_plan  # noqa: F401
 
 __all__ = [
+    "ClientArmy",
     "ClockSkew",
     "CrashStorm",
     "DiskFault",

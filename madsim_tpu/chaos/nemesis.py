@@ -117,8 +117,16 @@ class Nemesis:
         return self.log
 
     def _apply(self, handle, ev: FaultEvent) -> None:
+        from ..engine.core import FIRST_EXT_KIND, FIRST_USER_KIND
         from ..net.netsim import NetSim
 
+        if FIRST_USER_KIND <= ev.kind < FIRST_EXT_KIND:
+            raise ValueError(
+                f"nemesis cannot apply user kind {ev.kind}: client-army "
+                f"ops (chaos.ClientArmy) are a batched-engine load "
+                f"surface; on the asyncio runtime drive load with real "
+                f"client tasks instead"
+            )
         netsim = handle.simulator(NetSim)
         # dup toggles carry no node; disk-fault kinds resolve their own
         # targets (a0 may be -1 = every node)

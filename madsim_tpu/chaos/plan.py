@@ -37,6 +37,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..engine.core import (
+    FIRST_EXT_KIND,
+    FIRST_USER_KIND,
     KIND_CLOG,
     KIND_CLOG_1W,
     KIND_DUP_OFF,
@@ -59,6 +61,7 @@ from ..engine.core import (
     unpack_slow_arg,
 )
 from ..engine.rng import (
+    PURPOSE_CLIENT,
     PURPOSE_PLAN,
     chance_threshold,
     np_threefry2x32v,
@@ -70,6 +73,7 @@ __all__ = [
     "FaultPlan",
     "LiteralPlan",
     "SlotTemplate",
+    "ClientArmy",
     "CrashStorm",
     "PauseStorm",
     "Partition",
@@ -109,16 +113,26 @@ def kind_name(kind: int) -> str:
 
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:
-    """One concrete injected fault: an engine event at an absolute time."""
+    """One concrete injected event: an engine (or, for client-army
+    load, user) event at an absolute time. ``node`` is the pool row's
+    target — engine kinds ignore it (they act through args), user-kind
+    rows (ClientArmy ops) are delivered to it."""
 
     t: int  # ns from simulation start
-    kind: int  # engine / extended-chaos kind id
+    kind: int  # engine / extended-chaos / user kind id
     a0: int = 0
     a1: int = 0
+    node: int = 0
 
     def __str__(self) -> str:
         name = kind_name(self.kind)
         ms = self.t / 1e6
+        if FIRST_USER_KIND <= self.kind < FIRST_EXT_KIND:
+            # a client-army op: user kind delivered to its target node
+            return (
+                f"{ms:8.2f}ms client-op user[{self.kind - FIRST_USER_KIND}]"
+                f"(id={self.a0}, arg={self.a1}) -> n{self.node}"
+            )
         if self.kind in (KIND_SLOW_LINK, KIND_UNSLOW):
             b, mult = unpack_slow_arg(self.a1)
             peer = f"n{b}" if b >= 0 else "*"
@@ -152,7 +166,7 @@ class _Stream:
     slot for every seed at once — order-independent coordinates, same
     discipline as the engine's per-event draws."""
 
-    def __init__(self, seeds, slot: int, xp=np):
+    def __init__(self, seeds, slot: int, xp=np, purpose: int = PURPOSE_PLAN):
         self._xp = xp
         if xp is np:
             seeds = np.asarray(seeds, np.uint64)
@@ -160,7 +174,7 @@ class _Stream:
             seeds = jnp.asarray(seeds, jnp.uint64)
         self._k0 = (seeds & xp.uint64(0xFFFFFFFF)).astype(xp.uint32)
         self._k1 = (seeds >> xp.uint64(32)).astype(xp.uint32)
-        self._x1 = np.uint32((PURPOSE_PLAN + slot) & 0xFFFFFFFF)
+        self._x1 = np.uint32((purpose + slot) & 0xFFFFFFFF)
 
     def bits(self, j: int):
         if self._xp is np:
@@ -196,9 +210,11 @@ class _Stream:
 
 
 def _pack_slots(xp, s: int, rows):
-    """Stack per-slot ``(time, kind, a0, a1, valid)`` rows into the
-    (S, P[, 2]) column arrays ``compile_batch`` returns. Scalars
-    broadcast over the seed axis; works on both array backends."""
+    """Stack per-slot ``(time, kind, a0, a1, valid[, node])`` rows into
+    the (S, P[, 2]) column arrays ``compile_batch`` returns. Scalars
+    broadcast over the seed axis; works on both array backends. The
+    optional sixth entry is the pool row's target node (client-army
+    ops); absent = node 0, which engine kinds ignore."""
 
     def col(v, dtype):
         a = xp.asarray(v, dtype)
@@ -211,7 +227,10 @@ def _pack_slots(xp, s: int, rows):
     a0 = xp.stack([col(r[2], xp.int32) for r in rows], axis=1)
     a1 = xp.stack([col(r[3], xp.int32) for r in rows], axis=1)
     valid = xp.stack([col(r[4], xp.bool_) for r in rows], axis=1)
-    return time, kind, xp.stack([a0, a1], axis=2), valid
+    node = xp.stack(
+        [col(r[5] if len(r) > 5 else 0, xp.int32) for r in rows], axis=1
+    )
+    return time, kind, xp.stack([a0, a1], axis=2), valid, node
 
 
 @dataclasses.dataclass(frozen=True)
@@ -772,9 +791,123 @@ class DiskFault:
         return tuple(out)
 
 
+@dataclasses.dataclass(frozen=True)
+class ClientArmy:
+    """Open-loop client load: ``n_ops`` user-kind pool rows delivered to
+    ``node`` at threefry-drawn arrival times (madsim_tpu.obs latency).
+
+    The open-loop property is structural: arrivals are *compiled* from
+    ``(seed, PURPOSE_CLIENT + slot)`` coordinates into pre-seeded pool
+    rows, so the offered load is a pure function of the seed — the same
+    arrival schedule hits the protocol whatever the faults do to it,
+    which is what makes tail latency a measurable property instead of a
+    feedback artifact (a closed-loop client slows down exactly when the
+    system does, hiding the queueing the SLO cares about).
+
+    Each op's row carries ``args = (op_base + i, arg word)``: the op id
+    indexes the engine's latency columns (``LatencySpec.ops`` must cover
+    ``op_base + n_ops``), and the arg word is a uniform draw in
+    [0, ``arg_hi``) for workloads whose client surface wants a key or
+    value (0 when ``arg_hi`` is 0). ``kind`` is the workload's client
+    handler (``engine.user_kind(...)``) — the models export bound
+    helpers (``models.kvchaos.client_army`` / ``models.raftlog
+    .client_army``) so callers never hand-pick handler ids.
+
+    A ClientArmy composes into a :class:`FaultPlan` like any fault spec
+    (same slot/offset/mutation discipline), so chaos windows and client
+    load live in ONE plan: the hunt can retime a gray-failure window
+    INTO the arrival window, and ddmin can shrink away the ops that
+    don't matter to a breach.
+    """
+
+    node: int  # target node (the workload's client surface)
+    kind: int  # user kind id of the client handler (engine.user_kind)
+    n_ops: int = 256
+    t_min_ns: int = 20_000_000
+    t_max_ns: int = 400_000_000
+    arg_hi: int = 0  # args[1] drawn uniform in [0, arg_hi); 0 = constant 0
+    op_base: int = 0  # first op id (several armies share the lat columns)
+
+    def __post_init__(self):
+        if self.node < 0:
+            raise ValueError(f"ClientArmy node must be >= 0, got {self.node}")
+        if not FIRST_USER_KIND <= self.kind < FIRST_EXT_KIND:
+            raise ValueError(
+                f"ClientArmy.kind={self.kind} is not a user kind "
+                f"(engine.user_kind range [{FIRST_USER_KIND}, "
+                f"{FIRST_EXT_KIND})) — pass user_kind(handler_index)"
+            )
+        if self.n_ops < 1:
+            raise ValueError(f"n_ops must be >= 1, got {self.n_ops}")
+        if self.arg_hi < 0:
+            raise ValueError(f"arg_hi must be >= 0, got {self.arg_hi}")
+        if self.op_base < 0:
+            raise ValueError(f"op_base must be >= 0, got {self.op_base}")
+        _check_window(self.t_min_ns, self.t_max_ns, "arrival")
+
+    @property
+    def targets(self) -> tuple:
+        """The node this army addresses (the plan target validation
+        surface every spec exposes)."""
+        return (self.node,)
+
+    @property
+    def slots(self) -> int:
+        return self.n_ops
+
+    def compile_batch(self, seeds, slot: int, xp=np):
+        # the client stream is namespaced under PURPOSE_CLIENT (above
+        # PURPOSE_PLAN/PURPOSE_EXPLORE): arrival draws can never alias
+        # a chaos spec's draws even inside one composed plan
+        st = _Stream(seeds, slot, xp, purpose=PURPOSE_CLIENT)
+        rows = []
+        for i in range(self.n_ops):
+            at = st.uniform(self.t_min_ns, self.t_max_ns, 2 * i)
+            if self.arg_hi:
+                word = st.uniform(0, self.arg_hi, 2 * i + 1)
+            else:
+                word = 0
+            rows.append(
+                (at, self.kind, self.op_base + i, word, True, self.node)
+            )
+        return _pack_slots(xp, len(seeds), rows)
+
+    def slot_templates(self) -> tuple:
+        # mutation surface: retime within the arrival window (shift load
+        # toward/away from a fault), drop/add ops; args are fixed — the
+        # op id IS the latency slot, retargeting it would corrupt the
+        # measurement
+        return tuple(
+            SlotTemplate(
+                kind=self.kind, t_min_ns=self.t_min_ns,
+                t_max_ns=self.t_max_ns, arg_kind="none",
+            )
+            for _ in range(self.n_ops)
+        )
+
+
 # ---------------------------------------------------------------------------
 # plans
 # ---------------------------------------------------------------------------
+
+
+def _check_user_kind(kind: int, wl, what: str) -> None:
+    """User-kind plan rows must name a REAL handler of this workload:
+    the engine's dispatch clamps out-of-range user kinds to the last
+    handler (a documented no-crash rule for emit-time corruption), so
+    an army row aimed at a workload without the client surface would
+    silently dispatch the wrong handler instead of erroring."""
+    if not FIRST_USER_KIND <= kind < FIRST_EXT_KIND:
+        return
+    n_handlers = len(wl.handlers)
+    if kind - FIRST_USER_KIND >= n_handlers:
+        raise ValueError(
+            f"{what} injects user kind {kind} (handler index "
+            f"{kind - FIRST_USER_KIND}), but workload {wl.name!r} has "
+            f"only {n_handlers} handlers — a client army needs the "
+            f"workload built with its client surface enabled "
+            f"(e.g. make_kvchaos(army=True))"
+        )
 
 
 def _validate_targets(specs, wl) -> None:
@@ -786,6 +919,9 @@ def _validate_targets(specs, wl) -> None:
                     f"{type(spec).__name__} targets node {node}, but "
                     f"workload {wl.name!r} has n_nodes={n}"
                 )
+        kind = getattr(spec, "kind", None)
+        if isinstance(kind, int):
+            _check_user_kind(kind, wl, type(spec).__name__)
 
 
 class _PlanBase:
@@ -796,6 +932,9 @@ class _PlanBase:
     def compile(self, seed: int) -> list[FaultEvent]:
         """The concrete fault trajectory of one seed, in slot order."""
         rows = self.compile_batch(np.asarray([seed], np.uint64))
+        # both plan forms always materialize the node column; only
+        # hand-built PlanRows (the make_init boundary) may carry None
+        node = rows.node
         out = []
         for j in range(rows.time.shape[1]):
             if bool(rows.valid[0, j]):
@@ -805,6 +944,7 @@ class _PlanBase:
                         kind=int(rows.kind[0, j]),
                         a0=int(rows.args[0, j, 0]),
                         a1=int(rows.args[0, j, 1]),
+                        node=int(node[0, j]),
                     )
                 )
         return out
@@ -930,6 +1070,7 @@ class FaultPlan(_PlanBase):
             kind=xp.concatenate([p[1] for p in parts], axis=1),
             args=xp.concatenate([p[2] for p in parts], axis=1),
             valid=xp.concatenate([p[3] for p in parts], axis=1),
+            node=xp.concatenate([p[4] for p in parts], axis=1),
         )
 
     def slot_templates(self) -> tuple:
@@ -947,12 +1088,14 @@ class FaultPlan(_PlanBase):
         the FaultPlan run bit-identically — the corpus-entry form of
         madsim_tpu.explore."""
         rows = self.compile_batch(np.asarray([seed], np.uint64), wl=wl)
+        node = rows.node
         events = tuple(
             FaultEvent(
                 t=int(rows.time[0, j]),
                 kind=int(rows.kind[0, j]),
                 a0=int(rows.args[0, j, 0]),
                 a1=int(rows.args[0, j, 1]),
+                node=int(node[0, j]),
             )
             for j in range(rows.time.shape[1])
         )
@@ -1003,6 +1146,10 @@ class LiteralPlan(_PlanBase):
 
 
     def compile_batch(self, seeds, wl=None, device: bool = False) -> PlanRows:
+        if wl is not None:
+            for e, on in zip(self.events, self._mask()):
+                if on:
+                    _check_user_kind(e.kind, wl, "LiteralPlan event")
         xp = jnp if device else np
         seeds = xp.asarray(seeds, xp.uint64)
         s, p = len(seeds), len(self.events)
@@ -1011,6 +1158,7 @@ class LiteralPlan(_PlanBase):
         args = xp.asarray(
             [(e.a0, e.a1) for e in self.events], xp.int32
         ).reshape(p, 2)
+        node = xp.asarray([e.node for e in self.events], xp.int32)
         mask = xp.asarray(self._mask()) if device else self._mask()
         if device:
             return PlanRows(
@@ -1018,6 +1166,7 @@ class LiteralPlan(_PlanBase):
                 kind=xp.broadcast_to(kind, (s, p)),
                 args=xp.broadcast_to(args, (s, p, 2)),
                 valid=xp.broadcast_to(mask, (s, p)),
+                node=xp.broadcast_to(node, (s, p)),
             )
         # numpy rows stay writable copies: the shrinker masks them in place
         return PlanRows(
@@ -1025,13 +1174,20 @@ class LiteralPlan(_PlanBase):
             kind=np.broadcast_to(kind, (s, p)).copy(),
             args=np.broadcast_to(args, (s, p, 2)).copy(),
             valid=np.broadcast_to(mask, (s, p)).copy(),
+            node=np.broadcast_to(node, (s, p)).copy(),
         )
 
     def to_dict(self) -> dict:
-        """JSON-ready form (the exploration corpus/artifact format)."""
+        """JSON-ready form (the exploration corpus/artifact format).
+        The node word is appended only when some event targets one, so
+        pre-army artifacts stay byte-identical."""
+        if any(e.node for e in self.events):
+            events = [[e.t, e.kind, e.a0, e.a1, e.node] for e in self.events]
+        else:
+            events = [[e.t, e.kind, e.a0, e.a1] for e in self.events]
         return {
             "name": self.name,
-            "events": [[e.t, e.kind, e.a0, e.a1] for e in self.events],
+            "events": events,
             "enabled": [bool(x) for x in self._mask()],
         }
 
@@ -1039,8 +1195,12 @@ class LiteralPlan(_PlanBase):
     def from_dict(cls, d: dict) -> "LiteralPlan":
         return cls(
             events=tuple(
-                FaultEvent(t=int(t), kind=int(k), a0=int(a0), a1=int(a1))
-                for t, k, a0, a1 in d["events"]
+                FaultEvent(
+                    t=int(row[0]), kind=int(row[1]), a0=int(row[2]),
+                    a1=int(row[3]),
+                    node=int(row[4]) if len(row) > 4 else 0,
+                )
+                for row in d["events"]
             ),
             enabled=tuple(bool(x) for x in d.get("enabled", ())),
             name=d.get("name", "literal"),
@@ -1074,4 +1234,7 @@ def stack_plan_rows(plans) -> PlanRows:
         valid=np.array([pl._mask() for pl in plans], bool).reshape(
             len(plans), p
         ),
+        node=np.array(
+            [[e.node for e in pl.events] for pl in plans], np.int32
+        ).reshape(len(plans), p),
     )

@@ -90,6 +90,7 @@ def shrink_plan(
     max_steps: int = 1000,
     layout: str | None = None,
     require_halt: bool = False,
+    latency=None,
 ) -> ShrinkResult:
     """ddmin a failing ``(seed, plan)`` to a minimal fault-event subset.
 
@@ -102,6 +103,13 @@ def shrink_plan(
     event (a restart, an unclog) strands the run un-halted and ddmin
     happily "minimizes" to a different failure mode. Set it True only
     when shrinking a liveness failure.
+
+    ``latency`` (an ``engine.LatencySpec``) compiles the tail-latency
+    tap into the shrink runs — required when the invariant is an SLO
+    check (``check.slo_bounded``) reading ``lat_hist``: shrinking a
+    latency violation needs the sketch it judges. Plans holding
+    ``ClientArmy`` slots shrink like any other — ddmin drops the client
+    ops a breach does not need right alongside the faults.
 
     Raises ValueError if the full plan does not fail on ``seed`` (a
     shrink needs a failing input).
@@ -136,8 +144,10 @@ def shrink_plan(
                 f"config); shrink the plan windows or disable time32"
             )
     dup = plan.uses_dup()
-    init = make_init(wl, cfg, plan_slots=p)
-    run = jax.jit(make_run_while(wl, cfg, max_steps, layout=layout, dup_rows=dup))
+    init = make_init(wl, cfg, plan_slots=p, latency=latency)
+    run = jax.jit(make_run_while(
+        wl, cfg, max_steps, layout=layout, dup_rows=dup, latency=latency,
+    ))
     seeds_b = np.full((b,), seed, np.uint64)
     tested = 0
 

@@ -20,9 +20,16 @@ Three layers, one history representation:
 * **Exact checker** (check/linearize.py): Wing–Gong/porcupine-style
   linearizability for register and KV histories, per seed.
 
-This package imports nothing from the engine — it is a pure host-side
-consumer of the recorded columns, usable on engine results, compacted
-search views, and Recorder histories alike.
+A fourth detector judges *latency* instead of histories:
+``slo_bounded`` (check/slo.py) flags seeds whose per-window tail
+quantile breaches an SLO bound, read off the engine's latency sketches
+(``search_seeds(latency=...)``) — an SLO breach is a violation like
+any other, searchable, shrinkable and replayable.
+
+The history layers import nothing from the engine — they are pure
+host-side consumers of the recorded columns, usable on engine results,
+compacted search views, and Recorder histories alike (check/slo.py
+reads only the engine's static bucket-ladder constants).
 """
 
 from .history import (  # noqa: F401
@@ -43,6 +50,7 @@ from .history import (  # noqa: F401
 )
 from .linearize import LinResult, check_kv, check_register  # noqa: F401
 from .recorder import Recorder  # noqa: F401
+from .slo import slo_bounded, slo_breaches  # noqa: F401
 from .vectorized import (  # noqa: F401
     election_safety,
     monotonic_reads,
@@ -76,5 +84,7 @@ __all__ = [
     "monotonic_reads_strict",
     "read_your_writes",
     "recovery_safety",
+    "slo_bounded",
+    "slo_breaches",
     "stale_reads",
 ]

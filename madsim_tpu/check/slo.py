@@ -1,0 +1,93 @@
+"""SLO-violation detection over the engine's latency sketches.
+
+The tail-latency analog of the vectorized history detectors: a
+violation is not a lost write but a *latency objective breach* — the
+p99 (or any quantile) of the client-observed response time exceeding a
+bound. The check is evaluated **per measurement window** (the
+``LatencySpec.phases`` cut), which is what makes it gray-failure-aware:
+a 150 ms fault window that blows the tail 10x is invisible in a
+whole-run percentile (diluted by the healthy windows) but is exactly
+one window's histogram here.
+
+``slo_bounded`` returns a predicate with the ``search_seeds``
+final-state ``invariant`` contract (view dict -> (S,) bool, True =
+clean), so SLO breaches join the detector family: they count as
+violations in searches, guide the explore hunt, shrink under ddmin
+(``shrink_plan(latency=...)``) and replay exactly like any safety
+violation.
+
+Resolution contract (documented, not silent): quantiles live on the
+fixed ladder (``engine.LAT_EDGES_NS``), so the bound is judged at
+bucket resolution — a seed is flagged only when the quantile bucket's
+LOWER edge exceeds the bound, i.e. when the true quantile *provably*
+exceeds it. Breaches inside the same bucket as the bound are not
+flagged (under-flag, never false-flag — the vectorized-detector rule).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..engine.core import N_LAT_BUCKETS, lat_bucket_lo
+
+__all__ = ["slo_bounded", "slo_breaches"]
+
+
+def slo_breaches(
+    lat_hist: np.ndarray,
+    bound_ns: int,
+    q: float = 0.99,
+    min_ops: int = 16,
+) -> np.ndarray:
+    """(S, P, B) sketches -> (S,) True where some window breaches.
+
+    A window is judged only when it completed at least ``min_ops`` ops
+    (a one-op window has no p99; requiring a floor keeps a single slow
+    straggler from flagging a seed). The quantile-rank convention is
+    shared with ``obs.hist_quantile_bucket``.
+    """
+    from ..obs.latency import hist_quantile_bucket
+
+    h = np.asarray(lat_hist, np.int64)
+    if h.ndim != 3 or h.shape[2] != N_LAT_BUCKETS:
+        raise ValueError(
+            f"lat_hist must be (S, P, {N_LAT_BUCKETS}), got shape {h.shape}"
+        )
+    if not 0.0 < q < 1.0:
+        raise ValueError(f"q must be in (0, 1), got {q}")
+    if min_ops < 1:
+        raise ValueError(f"min_ops must be >= 1, got {min_ops}")
+    total = h.sum(axis=-1)  # (S, P)
+    bucket = hist_quantile_bucket(h, q)  # (S, P), -1 where empty
+    # provable breach: the whole quantile bucket sits above the bound
+    lo = lat_bucket_lo(np.clip(bucket, 0, None))
+    breach = (total >= min_ops) & (bucket >= 0) & (lo > int(bound_ns))
+    return breach.any(axis=-1)
+
+
+def slo_bounded(
+    bound_ns: int,
+    q: float = 0.99,
+    min_ops: int = 16,
+):
+    """Build a ``search_seeds`` invariant: every measurement window's
+    ``q``-quantile latency stays at-or-under ``bound_ns``.
+
+    Requires the sweep to run with ``latency=LatencySpec(...)`` (and a
+    ``chaos.ClientArmy`` — or hand-rolled ``lat_start/lat_end`` markers
+    — actually producing ops); a sweep without the tap raises rather
+    than silently passing every seed.
+    """
+
+    def invariant(view) -> np.ndarray:
+        h = np.asarray(view["lat_hist"])
+        if h.ndim != 3 or h.shape[1] == 0 or h.shape[2] == 0:
+            raise ValueError(
+                "slo_bounded needs latency sketches: run the sweep with "
+                "latency=LatencySpec(...) (engine latency tap) and a "
+                "client army producing ops"
+            )
+        return ~slo_breaches(h, bound_ns, q=q, min_ops=min_ops)
+
+    invariant.__name__ = f"slo_p{int(q * 1000)}_le_{int(bound_ns)}ns"
+    return invariant

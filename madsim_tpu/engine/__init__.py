@@ -55,9 +55,15 @@ from .core import (  # noqa: E402,F401
     EngineConfig,
     HandlerCtx,
     HistorySpec,
+    LAT_EDGES_NS,
+    LatencySpec,
+    N_LAT_BUCKETS,
     PlanRows,
     SimState,
     Workload,
+    lat_bucket,
+    lat_bucket_hi,
+    lat_bucket_lo,
     core_fields,
     derived_fields,
     make_init,

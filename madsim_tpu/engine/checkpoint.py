@@ -35,10 +35,12 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # observability columns (cov_hits/met/tl_*, madsim_tpu.obs); format 7:
 # storage sync-discipline columns (disk/wmask/sync_loss/torn,
 # madsim_tpu.chaos disk faults); format 8: the observable fsync-EIO
-# window column (sync_eio, ctx.sync_err). Older checkpoints are
-# rejected with the designed mismatch error rather than a KeyError
-# mid-load
-_FORMAT = 8
+# window column (sync_eio, ctx.sync_err); format 9: the tail-latency
+# columns (lat_inv/lat_resp/lat_hist/lat_count/lat_drop) and the
+# emit-time sidecar (ev_emit/tl_emit, madsim_tpu.obs latency). Older
+# checkpoints are rejected with the designed mismatch error rather
+# than a KeyError mid-load
+_FORMAT = 9
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

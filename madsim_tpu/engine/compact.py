@@ -92,6 +92,15 @@ RESULT_FIELDS = (
     "tl_meta",
     "tl_args",
     "tl_pay",
+    "tl_emit",
+    # tail-latency columns (madsim_tpu.obs latency): the sketch and its
+    # counters bank (SLO invariants read lat_hist on compacted runs);
+    # the per-op lat_inv/lat_resp clocks do NOT — they are the heavy
+    # (C,)-wide forensics columns, and banked sweeps consume only the
+    # sketch (the cov_hits rule applied again).
+    "lat_hist",
+    "lat_count",
+    "lat_drop",
 )
 
 
@@ -116,6 +125,7 @@ def make_run_compacted(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency=None,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -131,7 +141,7 @@ def make_run_compacted(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount,
+        metrics, timeline_cap, cov_hitcount, latency,
     ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:

@@ -73,6 +73,12 @@ from .rng import (
 __all__ = [
     "EngineConfig",
     "HistorySpec",
+    "LatencySpec",
+    "N_LAT_BUCKETS",
+    "LAT_EDGES_NS",
+    "lat_bucket",
+    "lat_bucket_lo",
+    "lat_bucket_hi",
     "Workload",
     "SimState",
     "Emits",
@@ -282,6 +288,80 @@ METRIC_NAMES = (
     "sync", "sync_lost", "torn",
 )
 
+# ---------------------------------------------------------------------------
+# Tail-latency sketch ladder (madsim_tpu.obs latency). Per-op latencies
+# fold ON DEVICE into a per-seed log-linear histogram — the property
+# that matters from t-digest is *exact mergeability* (sketch of a union
+# = sum of sketches), which a FIXED bucket ladder gives for free while
+# staying pure integer arithmetic (bit-identical across backends, like
+# every other column). Ladder: bucket 0 holds [0, 64 µs); buckets 1..62
+# are quarter-octaves (edge ratio 2^(1/4) ≈ 1.19x) from 64 µs up to
+# ~3.0 s; bucket 63 saturates above that. Quantiles read off the ladder
+# are exact to one bucket of rank error — ~19% relative, far inside
+# what any p99 SLO statement needs — and the ladder is a static module
+# constant, so merged sketches from any run ever taken remain
+# comparable.
+# ---------------------------------------------------------------------------
+N_LAT_BUCKETS = 64
+_LAT_EDGE0_NS = 1 << 16  # 65.536 µs, the bottom of the interesting range
+# 63 edges; bucket(v) = #edges <= v, in 0..63. Rounded to exact int64
+# once, host-side: the table itself is the spec.
+LAT_EDGES_NS = np.asarray(
+    [int(round(_LAT_EDGE0_NS * 2.0 ** (b / 4.0))) for b in range(N_LAT_BUCKETS - 1)],
+    np.int64,
+)
+
+
+def lat_bucket(v_ns) -> np.ndarray:
+    """Host-side ladder lookup: bucket index of a latency (vectorized)."""
+    return np.searchsorted(LAT_EDGES_NS, np.asarray(v_ns, np.int64), side="right")
+
+
+def lat_bucket_lo(b) -> np.ndarray:
+    """Inclusive lower edge of bucket ``b`` (0 for bucket 0)."""
+    b = np.asarray(b, np.int64)
+    return np.where(b <= 0, 0, LAT_EDGES_NS[np.clip(b - 1, 0, N_LAT_BUCKETS - 2)])
+
+
+def lat_bucket_hi(b) -> np.ndarray:
+    """Exclusive upper edge of bucket ``b`` (the top bucket saturates at
+    the last edge — values above it are reported AS that edge, loudly
+    documented rather than silently exact)."""
+    b = np.asarray(b, np.int64)
+    return LAT_EDGES_NS[np.clip(b, 0, N_LAT_BUCKETS - 2)]
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencySpec:
+    """Build parameters of the engine's latency observability tap.
+
+    ``ops`` sizes the per-seed op-slot columns: every client-army op id
+    must lie in [0, ops). ``phases``/``phase_ns`` cut the run into
+    fixed measurement windows (an op belongs to the window its INVOKE
+    fell in; the last window is open-ended): per-window sketches are
+    what makes an SLO check gray-failure-aware — a p99 blowup during a
+    120 ms fault window is invisible in a whole-run percentile but is
+    exactly window k's histogram. Hashable (frozen), so it keys the
+    compiled-run caches like every other build flag.
+    """
+
+    ops: int
+    phases: int = 1
+    phase_ns: int = 1 << 27  # ~134 ms, the coverage time-phase width
+
+    def __post_init__(self):
+        if self.ops < 1:
+            raise ValueError(f"LatencySpec.ops must be >= 1, got {self.ops}")
+        if self.phases < 1:
+            raise ValueError(
+                f"LatencySpec.phases must be >= 1, got {self.phases}"
+            )
+        if self.phase_ns < 1:
+            raise ValueError(
+                f"LatencySpec.phase_ns must be >= 1, got {self.phase_ns}"
+            )
+
+
 # MET_HALT_CODE values
 HALT_RUNNING = 0  # still live (or stopped only by the step cap)
 HALT_DONE = 1  # workload emitted KIND_HALT: scenario complete
@@ -313,6 +393,13 @@ DERIVED_STATE_FIELDS = (
     "cov", "cov_last", "cov_hits",
     "met",
     "tl_count", "tl_drop", "tl_t", "tl_meta", "tl_args", "tl_pay",
+    # emit-time sidecar (timeline_cap > 0): the pool row's insertion
+    # clock, read only into tl_emit — flow-arrow anchoring, never the
+    # trajectory
+    "ev_emit", "tl_emit",
+    # tail-latency columns (LatencySpec): per-op invoke/response clocks
+    # and the per-seed log-linear sketch
+    "lat_inv", "lat_resp", "lat_hist", "lat_count", "lat_drop",
 )
 
 # the two-phase sync-discipline columns: derived (zero-size) when
@@ -450,9 +537,16 @@ class Emits:
     # window makes the disk lie). A scalar, not per-slot: one dispatch
     # is one fsync decision. Ignored when the discipline is off.
     sync: jnp.ndarray = None  # () bool
+    # latency markers (L = Workload.lat_markers, 0 = off): each row
+    # marks one client-army op — lat[j] = (op_id, phase) with phase 0 =
+    # invoke (EmitBuilder.lat_start) and 1 = response (lat_end). The
+    # engine stamps the dispatch clock into the latency columns; with
+    # the latency tap off the markers are dead values XLA removes.
+    lat_valid: jnp.ndarray = None  # (L,) bool
+    lat: jnp.ndarray = None  # (L, 2) int32
 
     @staticmethod
-    def none(k: int, w: int = 0, a: int = 4, r: int = 0) -> "Emits":
+    def none(k: int, w: int = 0, a: int = 4, r: int = 0, l: int = 0) -> "Emits":
         return Emits(
             valid=jnp.zeros((k,), jnp.bool_),
             send=jnp.zeros((k,), jnp.bool_),
@@ -464,6 +558,8 @@ class Emits:
             rec_valid=jnp.zeros((r,), jnp.bool_),
             rec=jnp.zeros((r, 4), jnp.int32),
             sync=jnp.asarray(False),
+            lat_valid=jnp.zeros((l,), jnp.bool_),
+            lat=jnp.zeros((l, 2), jnp.int32),
         )
 
 
@@ -474,14 +570,16 @@ class EmitBuilder:
     flag is the traced per-seed condition making an emit conditional.
     """
 
-    def __init__(self, k: int, w: int = 0, a: int = 4, r: int = 0):
+    def __init__(self, k: int, w: int = 0, a: int = 4, r: int = 0, l: int = 0):
         self._k = k
         self._w = w
         self._a = a
         self._r = r
+        self._l = l
         self._recs: list[tuple] = []
         self._rows: list[tuple] = []
         self._syncs: list = []
+        self._lats: list[tuple] = []
 
     def _push(self, send, kind, dst, delay, args, when, pay=()):
         if len(self._rows) >= self._k:
@@ -620,6 +718,37 @@ class EmitBuilder:
             )
         self._recs.append((when, op, key, arg, ok))
 
+    def _lat_mark(self, op_id, phase: int, when) -> None:
+        if self._l == 0:
+            raise ValueError(
+                "lat_start/lat_end need latency marker slots; set "
+                "Workload.lat_markers (the per-invocation marker count)"
+            )
+        if len(self._lats) >= self._l:
+            raise ValueError(
+                f"handler marks more than lat_markers={self._l} latency "
+                f"ops; raise Workload.lat_markers"
+            )
+        self._lats.append((when, op_id, phase))
+
+    def lat_start(self, op_id, when=True):
+        """Mark the INVOKE of client-army op ``op_id`` (madsim_tpu.obs
+        latency): the engine stamps this dispatch's clock into
+        ``lat_inv[op_id]``. The first start wins; repeats are ignored —
+        an open-loop army invokes each op id exactly once, so repeats
+        only arise from hand-built workloads. Derived state only: with
+        the latency tap off (``latency=None``) the marker costs nothing
+        and traces are bit-identical."""
+        self._lat_mark(op_id, 0, when)
+
+    def lat_end(self, op_id, when=True):
+        """Mark the RESPONSE of client-army op ``op_id``: the engine
+        stamps ``lat_resp[op_id]`` and folds the op's latency into the
+        per-seed log-linear sketch (``lat_hist``). First response wins
+        (a duplicated delivery does not count twice); an end without a
+        prior start is ignored (the invoke never happened)."""
+        self._lat_mark(op_id, 1, when)
+
     def _build_recs(self):
         r = self._r
         if not self._recs:
@@ -644,14 +773,34 @@ class EmitBuilder:
             sync = sync | jnp.asarray(wh, jnp.bool_)
         return sync
 
+    def _build_lats(self):
+        l = self._l
+        if not self._lats:
+            return (
+                jnp.zeros((l,), jnp.bool_),
+                jnp.zeros((l, 2), jnp.int32),
+            )
+        pad = l - len(self._lats)
+        valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_x) in self._lats]
+        rows = [
+            jnp.stack([jnp.asarray(oid, jnp.int32), jnp.int32(ph)])
+            for (_wh, oid, ph) in self._lats
+        ]
+        return (
+            jnp.stack(valid + [jnp.asarray(False)] * pad),
+            jnp.stack(rows + [jnp.zeros((2,), jnp.int32)] * pad),
+        )
+
     def build(self) -> Emits:
         k, w = self._k, self._w
         rec_valid, rec = self._build_recs()
         sync = self._build_sync()
+        lat_valid, lat = self._build_lats()
         if not self._rows:
             em = Emits.none(k, w, self._a)
             return dataclasses.replace(
-                em, rec_valid=rec_valid, rec=rec, sync=sync
+                em, rec_valid=rec_valid, rec=rec, sync=sync,
+                lat_valid=lat_valid, lat=lat,
             )
         pad = k - len(self._rows)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_r) in self._rows]
@@ -683,6 +832,8 @@ class EmitBuilder:
             rec_valid=rec_valid,
             rec=rec,
             sync=sync,
+            lat_valid=lat_valid,
+            lat=lat,
         )
 
 
@@ -738,11 +889,12 @@ class HandlerCtx:
     # gate on it (e.g. withhold an ack they cannot persist) are
     # value-identical to ungated ones on every fault-free trajectory.
     sync_err: jnp.ndarray = None
+    max_lat: int = 0  # latency marker slots (Workload.lat_markers)
 
     def emits(self) -> EmitBuilder:
         return EmitBuilder(
             self.max_emits, self.payload_words, self.args_words,
-            self.max_records,
+            self.max_records, self.max_lat,
         )
 
 
@@ -810,6 +962,13 @@ class Workload:
     # trajectory-identical either way when no disk faults are injected
     # (the revert is a no-op), which keeps oracle compares exact.
     durable_sync: bool = False
+    # latency marker slots per handler invocation (madsim_tpu.obs
+    # latency): how many EmitBuilder.lat_start/lat_end calls one
+    # dispatch may make. 0 (default) keeps the Emits pytree free of the
+    # marker rows — every pre-latency workload is byte-identical.
+    # Marker semantics are derived-state-only: the markers do nothing
+    # at all unless the step is built with a LatencySpec.
+    lat_markers: int = 0
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -840,6 +999,10 @@ class Workload:
             raise ValueError(
                 "durable_sync needs durable_cols: the sync discipline "
                 "governs exactly the columns that survive a kill"
+            )
+        if self.lat_markers < 0:
+            raise ValueError(
+                f"lat_markers must be >= 0, got {self.lat_markers}"
             )
         if self.handler_names is not None and len(self.handler_names) != len(
             self.handlers
@@ -954,6 +1117,24 @@ class SimState:
     tl_args: jnp.ndarray  # (T, A) int32 event args
     tl_pay: jnp.ndarray  # (T, W) int32 payload words — so the decoded
     # stream refolds to the certified trace for payload workloads too
+    # emit-time sidecar (timeline_cap > 0, else both zero-size):
+    # ev_emit[e] is the clock at which pool row e was INSERTED (the
+    # emitting dispatch's time; 0 for init/plan rows), carried into
+    # tl_emit so Perfetto flow arrows anchor at the true send time.
+    # Derived state only — read exclusively into the ring.
+    ev_emit: jnp.ndarray  # (E,) int64 when the ring is on, else (0,)
+    tl_emit: jnp.ndarray  # (T,) int64 emit clock per captured dispatch
+    # tail-latency columns (madsim_tpu.obs latency; C = LatencySpec.ops,
+    # 0 when the tap is off — zero-size, zero cost, bit-identical, the
+    # cov_words discipline once more). lat_inv/lat_resp are the per-op
+    # invoke/response clocks (-1 = not yet); lat_hist is the per-seed
+    # log-linear sketch, (P, B) over (LatencySpec.phases, N_LAT_BUCKETS)
+    # — exactly mergeable across seeds/shards by summation.
+    lat_inv: jnp.ndarray  # (C,) int64 invoke clock per op id, -1 = never
+    lat_resp: jnp.ndarray  # (C,) int64 response clock, -1 = incomplete
+    lat_hist: jnp.ndarray  # (P, B) int32 latency sketch
+    lat_count: jnp.ndarray  # () int32 completed ops folded into the sketch
+    lat_drop: jnp.ndarray  # () int32 markers with out-of-range op ids (loud)
 
     @property
     def sim_seconds(self):
@@ -1022,6 +1203,10 @@ class PlanRows:
     kind: jnp.ndarray  # (S, P) int32 engine/extended kind ids
     args: jnp.ndarray  # (S, P, 2) int32 — engine kinds read args[0:2]
     valid: jnp.ndarray  # (S, P) bool
+    # target node per row (chaos ClientArmy: USER-kind rows address a
+    # client node). None (the pre-army form) = every row targets node 0,
+    # which engine kinds ignore — old plans are bit-identical.
+    node: jnp.ndarray = None  # (S, P) int32, or None
 
 
 def _check_cov_words(cov_words: int) -> None:
@@ -1032,7 +1217,12 @@ def _check_cov_words(cov_words: int) -> None:
         )
 
 
-def _check_obs(cov_words: int, cov_hitcount: bool, timeline_cap: int) -> None:
+def _check_obs(
+    cov_words: int,
+    cov_hitcount: bool,
+    timeline_cap: int,
+    latency: "LatencySpec | None" = None,
+) -> None:
     """Observability build-parameter validation — shared by make_init and
     make_step so no mismatched pair of builders can be constructed."""
     if cov_hitcount and not cov_words:
@@ -1042,6 +1232,11 @@ def _check_obs(cov_words: int, cov_hitcount: bool, timeline_cap: int) -> None:
         )
     if timeline_cap < 0:
         raise ValueError(f"timeline_cap={timeline_cap} must be >= 0")
+    if latency is not None and not isinstance(latency, LatencySpec):
+        raise TypeError(
+            f"latency must be a LatencySpec or None, got "
+            f"{type(latency).__name__}"
+        )
 
 
 def make_init(
@@ -1053,6 +1248,7 @@ def make_init(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency: LatencySpec | None = None,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -1069,10 +1265,10 @@ def make_init(
     madsim_tpu.explore); must match the step builder's value. 0 (the
     default) compiles recording away entirely.
 
-    ``metrics``/``timeline_cap``/``cov_hitcount`` size the observability
-    columns (madsim_tpu.obs; see the make_step docstring); each must
-    match the step builder's value, and each defaults to off (zero-size
-    arrays, zero cost, bit-identical values).
+    ``metrics``/``timeline_cap``/``cov_hitcount``/``latency`` size the
+    observability columns (madsim_tpu.obs; see the make_step docstring);
+    each must match the step builder's value, and each defaults to off
+    (zero-size arrays, zero cost, bit-identical values).
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     p = plan_slots
@@ -1083,17 +1279,19 @@ def make_init(
         )
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
-    _check_obs(cov_words, cov_hitcount, timeline_cap)
+    _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
     del k
     w = wl.payload_words
     h = wl.history.capacity if wl.history is not None else 0
+    lat_c = latency.ops if latency is not None else 0
+    lat_p = latency.phases if latency is not None else 0
     tdtype = jnp.int32 if _resolve_time32(wl, cfg, time32) else jnp.int64
     base_state = jnp.asarray(wl.initial_state())
     # sync discipline: a fresh node's disk holds the initial image (the
     # durable columns of init_state are what a cold start reads back)
     d = n if wl.durable_sync else 0
 
-    def init_one(seed, pt=None, pk=None, pa=None, pv=None) -> SimState:
+    def init_one(seed, pt=None, pk=None, pa=None, pv=None, pn=None) -> SimState:
         seed = jnp.asarray(seed, jnp.uint64)
         ev_valid = jnp.zeros((e,), jnp.bool_).at[:n].set(True)
         ev_kind = jnp.full((e,), KIND_NOP, jnp.int32)
@@ -1101,16 +1299,35 @@ def make_init(
         ev_node = jnp.zeros((e,), jnp.int32).at[:n].set(jnp.arange(n, dtype=jnp.int32))
         ev_time = jnp.zeros((e,), tdtype)
         ev_args = jnp.zeros((e, wl.args_words), jnp.int32)
+        ev_epoch = jnp.zeros((e,), jnp.int32)
         if p:
-            # plan rows ride slots [n, n+p): engine kinds targeting node
-            # 0 from a timer source, epoch 0 (engine kinds bypass the
-            # epoch gate). At t=0 the time32 offset form equals the
-            # absolute form, so the cast below is exact for validated
-            # plans (times within the int32 horizon).
+            # plan rows ride slots [n, n+p): engine kinds target node 0
+            # from a timer source, epoch 0 (engine kinds bypass the
+            # epoch gate); client-army rows (USER kinds, chaos
+            # ClientArmy) carry their target in the plan's node column
+            # and ride the ANY-epoch sentinel (-1): open-loop load is
+            # addressed to whatever incarnation of the client is up at
+            # arrival time, so a kill+restart of the client drops only
+            # the ops that arrive while it is DOWN — not every op for
+            # the rest of the run (arrivals are wall-scheduled, not
+            # incarnation-scoped). The liveness gate still applies.
+            # At t=0 the time32 offset form equals the absolute form,
+            # so the cast below is exact for validated plans (times
+            # within the int32 horizon).
             ev_valid = ev_valid.at[n : n + p].set(pv)
             ev_kind = ev_kind.at[n : n + p].set(pk)
             ev_time = ev_time.at[n : n + p].set(pt.astype(tdtype))
             ev_args = ev_args.at[n : n + p, 0:2].set(pa)
+            is_user_row = (pk >= FIRST_USER_KIND) & (pk < FIRST_EXT_KIND)
+            ev_epoch = ev_epoch.at[n : n + p].set(
+                jnp.where(is_user_row, jnp.int32(-1), jnp.int32(0))
+            )
+            if pn is not None:
+                # clip to the meta byte range like every emit pack: an
+                # out-of-range target matches nothing downstream
+                ev_node = ev_node.at[n : n + p].set(
+                    jnp.clip(pn.astype(jnp.int32), -1, n)
+                )
         # src = -1 (timer), retry = 0 for every initial on_init event
         ev_meta = _meta_pack(
             ev_kind,
@@ -1130,7 +1347,7 @@ def make_init(
             ev_time=ev_time,
             ev_valid=ev_valid,
             ev_meta=ev_meta,
-            ev_epoch=jnp.zeros((e,), jnp.int32),
+            ev_epoch=ev_epoch,
             ev_args=ev_args,
             ev_pay=jnp.zeros((e, w), jnp.int32),
             alive=jnp.ones((n,), jnp.bool_),
@@ -1162,6 +1379,13 @@ def make_init(
             tl_meta=jnp.zeros((timeline_cap,), jnp.uint32),
             tl_args=jnp.zeros((timeline_cap, wl.args_words), jnp.int32),
             tl_pay=jnp.zeros((timeline_cap, w), jnp.int32),
+            ev_emit=jnp.zeros((e if timeline_cap else 0,), jnp.int64),
+            tl_emit=jnp.zeros((timeline_cap,), jnp.int64),
+            lat_inv=jnp.full((lat_c,), -1, jnp.int64),
+            lat_resp=jnp.full((lat_c,), -1, jnp.int64),
+            lat_hist=jnp.zeros((lat_p, N_LAT_BUCKETS if lat_c else 0), jnp.int32),
+            lat_count=jnp.int32(0),
+            lat_drop=jnp.int32(0),
         )
 
     def init(seeds, plan: PlanRows | None = None) -> SimState:
@@ -1172,12 +1396,18 @@ def make_init(
                     f"init was built with plan_slots={p}; pass the "
                     f"compiled PlanRows"
                 )
+            pn = getattr(plan, "node", None)
+            if pn is None:
+                # pre-army PlanRows: every row targets node 0 (engine
+                # kinds ignore the target, the historical layout)
+                pn = jnp.zeros_like(jnp.asarray(plan.kind, jnp.int32))
             return jax.vmap(init_one)(
                 seeds,
                 jnp.asarray(plan.time, jnp.int64),
                 jnp.asarray(plan.kind, jnp.int32),
                 jnp.asarray(plan.args, jnp.int32),
                 jnp.asarray(plan.valid, jnp.bool_),
+                jnp.asarray(pn, jnp.int32),
             )
         return jax.vmap(init_one)(seeds)
 
@@ -1218,6 +1448,7 @@ def make_step(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency: LatencySpec | None = None,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -1286,6 +1517,16 @@ def make_step(
       behavior recurring an order of magnitude more often is new
       coverage. Changes which bits mean what — campaigns must not mix
       flag states — but never the trajectory.
+    * ``latency=LatencySpec(ops=C, ...)`` compiles the tail-latency
+      tap: handlers mark client-army op invokes/responses
+      (``EmitBuilder.lat_start/lat_end``), the engine stamps dispatch
+      clocks into the per-op ``lat_inv``/``lat_resp`` columns and folds
+      each completed op's latency into the per-seed log-linear sketch
+      ``lat_hist`` (one histogram per measurement window — the
+      invoke-time phase). When coverage is also on, each completion
+      folds a (window, latency-bucket) feature, so "the tail moved"
+      is new coverage the guided hunt can chase. Out-of-range op ids
+      count loudly in ``lat_drop``.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -1295,6 +1536,12 @@ def make_step(
     # (both 0 when recording is off — the history block compiles away)
     hcap = wl.history.capacity if wl.history is not None else 0
     rr = wl.history.max_records if wl.history is not None else 0
+    # latency tap: C op slots / P windows (both 0 when off) and the
+    # per-invocation marker slots L (an Emits-shape constant like rr)
+    ll = wl.lat_markers
+    lat_c = latency.ops if latency is not None else 0
+    lat_p = latency.phases if latency is not None else 0
+    lat_phase_ns = latency.phase_ns if latency is not None else 1
     # numpy (not jnp) so they embed as literals: a jnp closure constant
     # would block wrapping the step in a pallas kernel (pallas requires
     # traced constants to be declared inputs)
@@ -1309,7 +1556,7 @@ def make_step(
     n_user = len(wl.handlers)
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
-    _check_obs(cov_words, cov_hitcount, timeline_cap)
+    _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
     if layout is None:
         layout = "scatter" if jax.default_backend() == "cpu" else "dense"
     if layout not in ("dense", "scatter"):
@@ -1341,6 +1588,7 @@ def make_step(
             args_words=aw,
             max_records=rr,
             sync_err=eio,
+            max_lat=ll,
         )
 
     def _user_branch(handler):
@@ -1368,6 +1616,22 @@ def make_step(
                 # hand-built Emits: no sync flag — normalize so the
                 # switch branches share one pytree shape
                 emits = dataclasses.replace(emits, sync=jnp.asarray(False))
+            lv = emits.lat_valid
+            if lv is None or (ll > 0 and lv.shape[0] == 0):
+                # hand-built Emits: no latency markers — normalize to
+                # the branch pytree shape (the rec rule again)
+                emits = dataclasses.replace(
+                    emits,
+                    lat_valid=jnp.zeros((ll,), jnp.bool_),
+                    lat=jnp.zeros((ll, 2), jnp.int32),
+                )
+            elif lv.shape[0] != ll:
+                raise ValueError(
+                    f"handler returned Emits with {lv.shape[0]} latency-"
+                    f"marker rows but Workload.lat_markers={ll}; build "
+                    f"emits via ctx.emits() (EmitBuilder) to get the "
+                    f"right row count"
+                )
             return jnp.asarray(new_state, jnp.int32), emits
 
         return branch
@@ -1443,6 +1707,9 @@ def make_step(
         args = pick_slot(st.ev_args)
         ev_epoch_i = pick_slot(st.ev_epoch)
         pay_i = pick_slot(st.ev_pay)
+        # emit-time sidecar (ring on): when THIS event entered the pool
+        # — read before placement can reuse the freed slot
+        emit_i = pick_slot(st.ev_emit) if timeline_cap else jnp.int64(0)
         # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too:
         # dispatched inline, exempt from the epoch/pause gates
         is_engine = (kind < FIRST_USER_KIND) | (kind >= FIRST_EXT_KIND)
@@ -1482,8 +1749,15 @@ def make_step(
             eio_dst = jnp.asarray(False)
 
         # liveness/epoch gate: user events to a dead or reincarnated node
-        # are dropped — the kill-drops-futures semantics of task.rs:255-276
-        live = alive_dst & (epoch_dst == ev_epoch_i)
+        # are dropped — the kill-drops-futures semantics of task.rs:255-276.
+        # Epoch -1 is the ANY-epoch sentinel client-army plan rows carry
+        # (make_init): open-loop arrivals address whatever incarnation is
+        # up, so only the liveness half gates them. No emitted event ever
+        # carries -1 (emit epochs copy node epochs, which only grow), so
+        # sentinel-free runs take the exact historical gate.
+        live = alive_dst & (
+            (epoch_dst == ev_epoch_i) | (ev_epoch_i == jnp.int32(-1))
+        )
         # clogged links hold messages; re-check with exponential backoff
         # like the connection pump (net/mod.rs:341-355)
         if dense:
@@ -1571,7 +1845,7 @@ def make_step(
             user_state, uem = lax.switch(user_idx, user_branches, operand)
         else:
             # chaos-only workload: no user branches to run
-            user_state, uem = state_row, Emits.none(k, w, aw, rr)
+            user_state, uem = state_row, Emits.none(k, w, aw, rr, ll)
         user_dispatch = dispatch & ~is_engine
 
         # ---- apply node-state update (an OOB dst matches no row in the
@@ -1930,6 +2204,15 @@ def make_step(
             ev_epoch = place(e_epoch, st.ev_epoch)
             ev_args = place(em.args, st.ev_args)
             ev_pay = place(em.pay, st.ev_pay)
+            if timeline_cap:
+                # every inserted event was emitted at this dispatch's
+                # clock; rescheduled (clog-held) rows keep their
+                # original emit time — a retry is not a new send
+                ev_emit = place(
+                    jnp.broadcast_to(now, (k1,)), st.ev_emit
+                )
+            else:
+                ev_emit = st.ev_emit
         else:
             free = jnp.flatnonzero(~ev_valid_mid, size=k1, fill_value=e_slots)
             slot = jnp.where(
@@ -1943,6 +2226,12 @@ def make_step(
             ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
             ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
             ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
+            if timeline_cap:
+                ev_emit = st.ev_emit.at[slot].set(
+                    jnp.broadcast_to(now, (k1,)), mode="drop"
+                )
+            else:
+                ev_emit = st.ev_emit
 
         # ---- operation-history append (madsim_tpu.check) ----
         # the j-th valid record takes slot hist_count+j: same compact
@@ -1985,6 +2274,83 @@ def make_step(
         else:
             hist_count, hist_drop = st.hist_count, st.hist_drop
             hist_word, hist_t = st.hist_word, st.hist_t
+
+        # ---- tail-latency tap (madsim_tpu.obs latency) ----
+        # derived state only, the cov_words discipline: handler markers
+        # stamp per-op invoke/response clocks and fold completed ops
+        # into the per-seed log-linear sketch. Marker slots are few
+        # (L ~= 1-2), so each is handled by its own masked write — a
+        # static unroll, the same arithmetic in both layouts. Nothing
+        # here is ever read back by the trajectory, the RNG or the
+        # trace, so latency=None runs are bit-identical.
+        lat_feats = []  # (feature, on) pairs for the coverage fold
+        if lat_c:
+            lat_inv, lat_resp = st.lat_inv, st.lat_resp
+            lat_hist = st.lat_hist
+            lat_count, lat_drop = st.lat_count, st.lat_drop
+            lat_edges = jnp.asarray(LAT_EDGES_NS)
+            lat_ids = jnp.arange(lat_c, dtype=jnp.int32)
+            for j in range(ll):
+                mv = user_dispatch & uem.lat_valid[j]
+                oid = uem.lat[j, 0]
+                is_end = uem.lat[j, 1] == jnp.int32(1)
+                lat_in_r = (oid >= 0) & (oid < lat_c)
+                lat_drop = lat_drop + (mv & ~lat_in_r).astype(jnp.int32)
+                act = mv & lat_in_r
+                if dense:
+                    oid_oh = lat_ids == oid  # all-False when out of range
+                    inv_o = jnp.sum(jnp.where(oid_oh, lat_inv, 0))
+                    resp_o = jnp.sum(jnp.where(oid_oh, lat_resp, 0))
+                else:
+                    oc = jnp.clip(oid, 0, lat_c - 1)
+                    inv_o = jnp.where(lat_in_r, lat_inv[oc], jnp.int64(-1))
+                    resp_o = jnp.where(lat_in_r, lat_resp[oc], jnp.int64(-1))
+                # first start / first response win: an open-loop army
+                # invokes each id once, and a duplicated delivery's
+                # second lat_end must not double-count
+                do_start = act & ~is_end & (inv_o < 0)
+                do_end = act & is_end & (inv_o >= 0) & (resp_o < 0)
+                d = now - inv_o
+                bkt = jnp.sum((d >= lat_edges).astype(jnp.int32))
+                ph = jnp.clip(
+                    (inv_o // jnp.int64(lat_phase_ns)).astype(jnp.int32),
+                    0, lat_p - 1,
+                )
+                if dense:
+                    lat_inv = jnp.where(oid_oh & do_start, now, lat_inv)
+                    lat_resp = jnp.where(oid_oh & do_end, now, lat_resp)
+                    hsel = (
+                        (jnp.arange(lat_p, dtype=jnp.int32)[:, None] == ph)
+                        & (jnp.arange(N_LAT_BUCKETS, dtype=jnp.int32)[None, :] == bkt)
+                        & do_end
+                    )
+                    lat_hist = lat_hist + hsel.astype(jnp.int32)
+                else:
+                    lat_inv = lat_inv.at[
+                        jnp.where(do_start, oc, jnp.int32(lat_c))
+                    ].set(now, mode="drop")
+                    lat_resp = lat_resp.at[
+                        jnp.where(do_end, oc, jnp.int32(lat_c))
+                    ].set(now, mode="drop")
+                    lat_hist = lat_hist.at[
+                        jnp.where(do_end, ph, jnp.int32(lat_p)), bkt
+                    ].add(jnp.int32(1), mode="drop")
+                lat_count = lat_count + do_end.astype(jnp.int32)
+                # latency-bucket coverage feature: (window, bucket) —
+                # a schedule that pushes ops into a new bucket of a new
+                # window is NEW behavior, the guidance signal that lets
+                # the hunt chase "blow the tail" (folded in the cov
+                # block below, gated on cov_words like every feature)
+                lat_feats.append((
+                    bkt.astype(jnp.uint32)
+                    | (ph.astype(jnp.uint32) << jnp.uint32(8))
+                    | jnp.uint32(5 << 24),
+                    do_end,
+                ))
+        else:
+            lat_inv, lat_resp = st.lat_inv, st.lat_resp
+            lat_hist = st.lat_hist
+            lat_count, lat_drop = st.lat_count, st.lat_drop
 
         # ---- coverage taps (madsim_tpu.explore) ----
         # derived state only: features of the event just dispatched are
@@ -2117,6 +2483,10 @@ def make_step(
                 cov, cov_hits = _tap(
                     cov, cov_hits, f_rec, user_dispatch & uem.rec_valid[j]
                 )
+            # completed client-army ops: (measurement window, latency
+            # bucket) features computed in the latency block above
+            for f_lat, on_lat in lat_feats:
+                cov, cov_hits = _tap(cov, cov_hits, f_lat, on_lat)
             if dense:
                 cov_last = jnp.where(
                     dst_oh & user_dispatch, kind, st.cov_last
@@ -2206,18 +2576,20 @@ def make_step(
                 tl_meta = jnp.where(t_sel, meta_i, st.tl_meta)
                 tl_args = jnp.where(t_sel[:, None], args[None, :], st.tl_args)
                 tl_pay = jnp.where(t_sel[:, None], pay_i[None, :], st.tl_pay)
+                tl_emit = jnp.where(t_sel, emit_i, st.tl_emit)
             else:
                 t_slot = jnp.where(t_do, st.tl_count, jnp.int32(timeline_cap))
                 tl_t = st.tl_t.at[t_slot].set(now, mode="drop")
                 tl_meta = st.tl_meta.at[t_slot].set(meta_i, mode="drop")
                 tl_args = st.tl_args.at[t_slot].set(args, mode="drop")
                 tl_pay = st.tl_pay.at[t_slot].set(pay_i, mode="drop")
+                tl_emit = st.tl_emit.at[t_slot].set(emit_i, mode="drop")
             tl_count = st.tl_count + t_do.astype(jnp.int32)
             tl_drop = st.tl_drop + (dispatch & ~tfits).astype(jnp.int32)
         else:
             tl_count, tl_drop = st.tl_count, st.tl_drop
             tl_t, tl_meta, tl_args = st.tl_t, st.tl_meta, st.tl_args
-            tl_pay = st.tl_pay
+            tl_pay, tl_emit = st.tl_pay, st.tl_emit
 
         # ---- trace + clock ----
         trace = jnp.where(
@@ -2267,6 +2639,13 @@ def make_step(
             tl_meta=tl_meta,
             tl_args=tl_args,
             tl_pay=tl_pay,
+            ev_emit=ev_emit,
+            tl_emit=tl_emit,
+            lat_inv=lat_inv,
+            lat_resp=lat_resp,
+            lat_hist=lat_hist,
+            lat_count=lat_count,
+            lat_drop=lat_drop,
         )
 
     return step
@@ -2283,6 +2662,7 @@ def make_run(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency: LatencySpec | None = None,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -2300,7 +2680,7 @@ def make_run(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount,
+        metrics, timeline_cap, cov_hitcount, latency,
     ))
 
     def run(state: SimState) -> SimState:
@@ -2324,6 +2704,7 @@ def make_run_while(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency: LatencySpec | None = None,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -2341,7 +2722,7 @@ def make_run_while(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount,
+        metrics, timeline_cap, cov_hitcount, latency,
     ))
 
     def run(state: SimState) -> SimState:

@@ -52,7 +52,14 @@ _ENGINE_KIND_NAMES = {
 
 @dataclass(frozen=True)
 class ReplayEvent:
-    """One dispatched event: the tuple the trace hash folds."""
+    """One dispatched event: the tuple the trace hash folds.
+
+    ``emit_ns`` is the clock at which the event was INSERTED into the
+    pool — for a delivered message, the sender's dispatch that emitted
+    it (the true send time Perfetto flow arrows anchor at). -1 = not
+    captured (oracle replays and pre-emit rings); it never participates
+    in the trace fold.
+    """
 
     time_ns: int
     kind: int
@@ -60,6 +67,7 @@ class ReplayEvent:
     src: int  # -1 = timer/engine event, else sending node
     args: tuple
     pay: tuple
+    emit_ns: int = -1
 
     def kind_name(self, wl: Workload | None = None) -> str:
         # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too

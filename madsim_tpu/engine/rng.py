@@ -48,6 +48,7 @@ __all__ = [
     "PURPOSE_TORN",
     "PURPOSE_PLAN",
     "PURPOSE_EXPLORE",
+    "PURPOSE_CLIENT",
     "PURPOSE_USER",
 ]
 
@@ -103,6 +104,19 @@ PURPOSE_PLAN = 0x9E370000
 # never alias each other (and both sit far above every in-simulation
 # purpose).
 PURPOSE_EXPLORE = 0x9E380000
+
+# Open-loop client-army arrival generation (madsim_tpu.chaos
+# ClientArmy): arrival times and per-op argument words are threefry
+# draws keyed (seed, PURPOSE_CLIENT + plan slot) — one reproducible
+# stream per (seed, op), the BatchRNG varying-parameter-stream shape
+# again. Because arrivals are pool rows compiled from coordinates (not
+# in-simulation draws at a step counter), the offered load is a pure
+# function of the seed: the SAME arrival schedule hits the protocol
+# whatever trajectory the faults push it onto — the open-loop property
+# that makes tail latency measurable. Explore's batch slots stay below
+# 64k, so PURPOSE_EXPLORE + slot < PURPOSE_CLIENT keeps the host-side
+# streams disjoint.
+PURPOSE_CLIENT = 0x9E390000
 
 
 def _rotl32(x, r: int):

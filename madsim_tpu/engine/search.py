@@ -57,16 +57,18 @@ _RUN_CACHE: dict = {}
 def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   compact: bool, plan_slots: int = 0, dup_rows: bool = False,
                   cov_words: int = 0, metrics: bool = False,
-                  timeline_cap: int = 0, cov_hitcount: bool = False):
+                  timeline_cap: int = 0, cov_hitcount: bool = False,
+                  latency=None):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
-           dup_rows, cov_words, metrics, timeline_cap, cov_hitcount)
+           dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
+           latency)
     if key not in _RUN_CACHE:
         obs_kw = dict(
             metrics=metrics, timeline_cap=timeline_cap,
-            cov_hitcount=cov_hitcount,
+            cov_hitcount=cov_hitcount, latency=latency,
         )
         if compact:
             run = make_run_compacted(
@@ -130,6 +132,16 @@ class SearchReport:
     pool_overflowed: np.ndarray | None = None
     hist_dropped: np.ndarray | None = None
     tl_dropped: np.ndarray | None = None
+    # tail-latency columns (latency=LatencySpec(...)): the per-seed
+    # log-linear sketches (S, phases, N_LAT_BUCKETS) and completed-op
+    # counts — reduce fleet-wide with obs.latency_reduce; SLO verdicts
+    # come from check.slo_bounded as the sweep's invariant. lat_dropped
+    # flags seeds whose markers named op ids outside LatencySpec.ops —
+    # their sketches undercount, so it is loud in the banner (the
+    # tl_drop rule: forensic loudness, verdicts judge what WAS folded)
+    lat_hist: np.ndarray | None = None
+    lat_count: np.ndarray | None = None
+    lat_dropped: np.ndarray | None = None
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -208,6 +220,13 @@ class SearchReport:
                 f"overflowed the timeline ring (raise timeline_cap; "
                 f"verdicts unaffected — the timeline is forensics only)"
             )
+        if self.lat_dropped is not None and self.lat_dropped.any():
+            lines.append(
+                f"  WARNING: {int(self.lat_dropped.sum())} seed(s) "
+                f"dropped latency markers (op ids outside "
+                f"LatencySpec.ops) — their sketches undercount; size "
+                f"LatencySpec.ops to cover every army op id"
+            )
         plan = f" plan_hash={self.plan_hash}" if self.plan_hash else ""
         for s in bad[:limit]:
             lines.append(
@@ -249,6 +268,7 @@ def search_seeds(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency=None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -303,8 +323,12 @@ def search_seeds(
     breakdown; ``timeline_cap=T`` captures each seed's dispatched-event
     stream (``report.timeline``, decode with ``obs.decode_timeline``);
     ``cov_hitcount=True`` switches the coverage bitmaps to AFL-style
-    hit-count bucketing. All three are derived state only — the traces
-    and verdicts are bit-identical with them off or on.
+    hit-count bucketing; ``latency=LatencySpec(...)`` runs the
+    tail-latency tap (client-army op clocks + per-seed sketches,
+    ``report.lat_hist``/``lat_count`` — reduce with
+    ``obs.latency_reduce``, judge with ``check.slo_bounded`` as the
+    invariant). All of them are derived state only — the traces and
+    verdicts are bit-identical with them off or on.
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
@@ -326,6 +350,21 @@ def search_seeds(
         plan_slots = int(plan.slots)
         if dup_rows is None:
             dup_rows = bool(plan.uses_dup())
+        if latency is not None:
+            # a client army whose op-id range exceeds the latency
+            # columns would silently drop every out-of-range marker
+            # (counted in lat_drop, but a whole mis-sized army is a
+            # build error, not a runtime anomaly)
+            for spec in getattr(plan, "specs", ()):
+                ob = getattr(spec, "op_base", None)
+                no = getattr(spec, "n_ops", None)
+                if ob is not None and no is not None and ob + no > latency.ops:
+                    raise ValueError(
+                        f"{type(spec).__name__} op ids "
+                        f"[{ob}, {ob + no}) exceed LatencySpec.ops="
+                        f"{latency.ops}; size the spec to cover every "
+                        f"army op id"
+                    )
         if cfg.time_limit_ns and hasattr(plan, "validate_windows"):
             # a fault window opening after the clock cap can never fire:
             # the sweep would silently certify the unfaulted protocol
@@ -350,7 +389,7 @@ def search_seeds(
         dup_rows = bool(dup_rows)
     init, run, _ = _compiled_run(
         wl, cfg, max_steps, layout, compact, plan_slots, dup_rows,
-        cov_words, metrics, timeline_cap, cov_hitcount,
+        cov_words, metrics, timeline_cap, cov_hitcount, latency,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):
@@ -428,7 +467,7 @@ def search_seeds(
         tl = SimpleNamespace(**{
             f: np.asarray(view[f])
             for f in ("tl_count", "tl_drop", "tl_t", "tl_meta",
-                      "tl_args", "tl_pay")
+                      "tl_args", "tl_pay", "tl_emit")
         })
         tl_dropped = tl.tl_drop > 0
     else:
@@ -450,4 +489,11 @@ def search_seeds(
         pool_overflowed=pool_overflowed,
         hist_dropped=hist_dropped,
         tl_dropped=tl_dropped,
+        lat_hist=np.asarray(view["lat_hist"]) if latency is not None else None,
+        lat_count=(
+            np.asarray(view["lat_count"]) if latency is not None else None
+        ),
+        lat_dropped=(
+            np.asarray(view["lat_drop"]) > 0 if latency is not None else None
+        ),
     )

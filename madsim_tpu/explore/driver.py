@@ -150,10 +150,15 @@ def _literal_from_rows(rows, j: int, name: str) -> LiteralPlan:
     kind = np.asarray(rows.kind)
     args = np.asarray(rows.args)
     valid = np.asarray(rows.valid)
+    # every in-loop PlanRows source (compile_batch, stack_plan_rows)
+    # materializes the node column; None only exists for hand-built
+    # rows at the make_init boundary
+    node = np.asarray(rows.node)
     events = tuple(
         FaultEvent(
             t=int(time[j, p]), kind=int(kind[j, p]),
             a0=int(args[j, p, 0]), a1=int(args[j, p, 1]),
+            node=int(node[j, p]),
         )
         for p in range(time.shape[1])
     )
@@ -193,6 +198,7 @@ def replay_entry(
     dup_rows: bool | None = None,
     metrics: bool = False,
     timeline_cap: int = 0,
+    latency=None,
 ) -> SearchReport:
     """Re-execute one corpus entry's exact ``(seed, plan)`` pair.
 
@@ -220,6 +226,7 @@ def replay_entry(
         plan_rows=stack_plan_rows([entry.plan]),
         plan_hash=entry.plan.hash(), dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
+        latency=latency,
     )
 
 
@@ -248,6 +255,7 @@ def run(
     telemetry=None,
     resume=None,
     checkpoint_path: str | None = None,
+    latency=None,
 ) -> ExploreReport:
     """Run one coverage-guided exploration campaign.
 
@@ -281,6 +289,13 @@ def run(
     (root seed, batch, space, config) — all validated against the
     checkpoint. ``checkpoint_path`` saves the campaign state after
     every generation (and is the natural ``resume`` input later).
+
+    ``latency`` (an ``engine.LatencySpec``) runs every generation with
+    the tail-latency tap on — the SLO hunt: with a ``chaos.ClientArmy``
+    in the plan space and ``check.slo_bounded`` as the invariant,
+    latency-bucket coverage bits steer the campaign toward schedules
+    that move the tail, and p99 breaches are violations like any other
+    (dedup, shrink, replay all apply).
     """
     import time as _time
 
@@ -382,6 +397,9 @@ def run(
                         (e.a0, e.a1) for e in padded.events
                     ]
                     np.asarray(rows.valid)[j] = padded._mask()
+                    np.asarray(rows.node)[j] = [
+                        e.node for e in padded.events
+                    ]
         else:
             # parent pool: violating entries first, NEWEST first — the
             # frontier keeps drifting into fresh trajectory
@@ -428,6 +446,7 @@ def run(
             history_invariant=history_invariant,
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
             cov_words=cov_words, cov_hitcount=cov_hitcount,
+            latency=latency,
         )
         dispatch_wall = _time.monotonic() - t_disp  # lint: allow(wall-clock)
         sims += batch

@@ -44,22 +44,32 @@ def main(argv=None) -> int:
 
     reports = []
     if args.jaxpr:
-        from .noninterference import BUILD_AXES, check_matrix, model_matrix
+        from .noninterference import (
+            BUILD_AXES,
+            LAYOUT_AXES,
+            check_matrix,
+            model_matrix,
+        )
 
-        want = ("raft/record", "raftlog/durable")
+        want = ("raft/record", "raftlog/durable", "kvchaos/army")
         models = [m for m in model_matrix() if m[0] in want]
         if len(models) != len(want):
             # fail LOUDLY on tag drift: a silent miss would either
-            # halve the smoke or (via the empty-filter fallback) trace
-            # the full 9-model matrix inside the tier-1 budget
+            # shrink the smoke or (via the empty-filter fallback) trace
+            # the full model matrix inside the tier-1 budget
             raise SystemExit(
                 f"lint --jaxpr: expected tags {want} in model_matrix(), "
                 f"found {[m[0] for m in models]} — update the smoke "
                 f"filter to match models/*.py lint_entries()"
             )
         # the same 'all' axis the soak matrix certifies — a new build
-        # flag added there is automatically smoked here too
-        reports = check_matrix(models, {"all": BUILD_AXES["all"]})
+        # flag added there is automatically smoked here too — over
+        # every lowering pair (scatter/int64, dense, time32): the TPU
+        # runs exactly the dense/time32 programs the historical smoke
+        # never traced
+        reports = check_matrix(
+            models, {"all": BUILD_AXES["all"]}, layouts=LAYOUT_AXES
+        )
 
     if args.json:
         print(

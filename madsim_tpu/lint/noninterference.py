@@ -33,6 +33,7 @@ import jax
 
 from ..engine.core import (
     EngineConfig,
+    LatencySpec,
     Workload,
     derived_fields,
     make_init,
@@ -48,6 +49,7 @@ __all__ = [
     "model_matrix",
     "plant_met_leak",
     "BUILD_AXES",
+    "LAYOUT_AXES",
 ]
 
 
@@ -134,6 +136,7 @@ def check_noninterference(
     metrics: bool = False,
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
+    latency: LatencySpec | None = None,
     n_steps: int = 4,
     n_seeds: int = 2,
     mutate=None,
@@ -152,14 +155,21 @@ def check_noninterference(
         layout=layout, time32=time32, dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
         cov_hitcount=cov_hitcount,
+        # JSON-able form (reports serialize): the spec's defining triple
+        latency=(
+            (latency.ops, latency.phases, latency.phase_ns)
+            if latency is not None else None
+        ),
     )
     obs_kw = dict(
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+        latency=latency,
     )
     init = make_init(
         wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+        latency=latency,
     )
     state = init(np.zeros(max(n_seeds, 1), np.uint64))
     if entry == "step":
@@ -271,10 +281,26 @@ BUILD_AXES = {
     "timeline": dict(timeline_cap=8),
     "coverage": dict(cov_words=8),
     "hitcount": dict(cov_words=8, cov_hitcount=True),
+    "latency": dict(latency=LatencySpec(ops=8, phases=2)),
     "all": dict(
         metrics=True, timeline_cap=8, cov_words=8, cov_hitcount=True,
+        latency=LatencySpec(ops=8, phases=2),
     ),
 }
+
+# lowering/representation axes: (layout, time32) pairs. The scatter
+# int64 build was the historical matrix; dense and time32 produce the
+# same jaxpr SHAPES (masked selects vs gathers, int32 vs int64 pool
+# times) but different equation graphs — the proof must hold over all
+# of them, and the COMBINED (dense, time32) pair is the exact program
+# an accelerator runs (layout and representation both auto-resolve
+# that way off-CPU), so it is swept too, not merely each axis alone.
+LAYOUT_AXES = (
+    ("scatter", False),
+    ("dense", False),
+    ("scatter", True),
+    ("dense", True),
+)
 
 def model_matrix() -> list:
     """(name, workload, config) triples for the four recorded models.
@@ -301,26 +327,38 @@ def check_matrix(
     *,
     entry: str = "step",
     layout: str = "scatter",
+    layouts: tuple | None = None,
     log=None,
 ) -> list:
     """Run the proof over a model x build-flag matrix; returns reports.
 
     Defaults to the full certified matrix (tools/lint_soak.py scale);
-    tests pass a slice for the tier-1 smoke.
+    tests pass a slice for the tier-1 smoke. ``layouts`` sweeps
+    (layout, time32) lowering pairs per cell (``LAYOUT_AXES`` is the
+    full set); the single ``layout`` argument remains the one-lowering
+    form. A model whose (workload, config) is not time32-eligible is
+    skipped for time32 pairs rather than failing the matrix.
     """
+    from ..engine.core import time32_eligible
+
     if models is not None and not models:
         # an explicitly empty slice is a caller bug (e.g. a tag filter
         # that matched nothing) — falling back to the full matrix here
         # would silently multiply the gate's cost instead
         raise ValueError("check_matrix: models is empty")
+    if layouts is None:
+        layouts = ((layout, False),)
     reports = []
     for name, wl, cfg in (models if models is not None else model_matrix()):
-        for axis, flags in (axes or BUILD_AXES).items():
-            rep = check_noninterference(
-                wl, cfg, entry=entry, layout=layout, **flags
-            )
-            rep.flags["axis"] = axis
-            if log is not None:
-                log(rep.summary())
-            reports.append(rep)
+        for lay, t32 in layouts:
+            if t32 and not time32_eligible(wl, cfg):
+                continue
+            for axis, flags in (axes or BUILD_AXES).items():
+                rep = check_noninterference(
+                    wl, cfg, entry=entry, layout=lay, time32=t32, **flags
+                )
+                rep.flags["axis"] = axis
+                if log is not None:
+                    log(rep.summary())
+                reports.append(rep)
     return reports

@@ -39,6 +39,13 @@ Rules (each Finding carries the rule name):
   round-trip inside a jitted step breaks both determinism (host
   effects are unordered across devices) and the never-move-state-
   to-host discipline.
+* ``fixed-key``       — ``jax.random.PRNGKey``/``jax.random.key`` with
+  a literal constant seed in library (sim) code: the repo's RNG
+  discipline is counter-based threefry keyed by the INSTANCE seed
+  (engine/rng.py); a hard-coded ``PRNGKey(0)`` silently correlates
+  "independent" draws across every seed in a batch and across every
+  call site sharing the constant. Derive keys from the instance seed
+  (or annotate a deliberately-fixed key).
 
 Pragmas: append ``# lint: allow(rule)`` (comma-separate several rules)
 to the offending line — or put it on a comment line directly above —
@@ -74,6 +81,7 @@ RULES = (
     "unordered-iter",
     "id-hash-branch",
     "host-callback",
+    "fixed-key",
     "unused-allow",
     "parse-error",
 )
@@ -116,6 +124,9 @@ _HOST_CB = {
     "jax.debug.print",
     "jax.experimental.host_callback.call",
 }
+
+# key constructors whose literal-constant seeds the fixed-key rule flags
+_JAX_KEY = {"jax.random.PRNGKey", "jax.random.key"}
 # bare suffixes that identify the same callables when imported directly
 # (``from jax.experimental import io_callback``)
 _HOST_CB_SUFFIX = {"io_callback", "pure_callback"}
@@ -307,6 +318,22 @@ class _Visitor(ast.NodeVisitor):
                 f"{name}() is a host round-trip inside sim code: host "
                 f"effects are unordered across devices and break the "
                 f"device-resident discipline",
+            )
+        elif (
+            self.sim_code
+            and name in _JAX_KEY
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            self._emit(
+                "fixed-key",
+                node,
+                f"{name}({node.args[0].value!r}) hard-codes an RNG key "
+                f"in library code: every batch row (and every call "
+                f"site sharing the constant) draws the SAME stream — "
+                f"derive the key from the instance seed "
+                f"(engine/rng.py), or annotate a deliberately-fixed "
+                f"key",
             )
 
     # -- unordered iteration -------------------------------------------

@@ -45,6 +45,9 @@ _H_JOIN = 8  # at primary: args = (replica,) — replica (re)joined
 _H_JRETX = 9  # at replica: retry JOIN until synced
 _H_READ = 10  # at primary: args = (rseq,) — record mode only
 _H_READRESP = 11  # at client: args = (rseq, committed) — record mode only
+_H_AREQ = 12  # at client: army op arrival, args = (op_id, word) — army mode
+_H_APROBE = 13  # at primary: army probe, args = (op_id,)
+_H_ARESP = 14  # at client: army response, args = (op_id, committed)
 
 PRIMARY = 0
 
@@ -65,6 +68,8 @@ def make_kvchaos(
     record: bool = False,
     hist_capacity: int | None = None,
     bug: bool = False,
+    army: bool = False,
+    army_probes: int = 1,
 ) -> Workload:
     """``payload=True`` turns on the engine payload arena: each WRITE
     carries two random int32 value words (drawn by the client, unknowable
@@ -92,6 +97,21 @@ def make_kvchaos(
     states (and the final-state durability invariant) look perfectly
     healthy — but a read landing in the regression window observes a
     committed write vanish, which only the history checkers can see.
+
+    ``army=True`` opens the model's **client surface** for open-loop
+    load (madsim_tpu.obs latency): a ``chaos.ClientArmy`` row arriving
+    at the client node (``client_army`` builds the spec) marks the op's
+    invoke, probes the primary, and the final response marks
+    completion — client-observed latency through the authority, the
+    quantity a tail SLO is stated over. ``army_probes=k`` makes each
+    op a k-round SESSION (the probes chain sequentially; the op
+    completes on the k-th response), the multi-round-operation shape
+    real client calls have — under gray failure a session is slowed
+    end to end only by SUSTAINED slowness, which is exactly what
+    separates a windowed SLO breach from a blip. The probe path reads
+    protocol state but never writes it, so army load measures (and
+    perturbs the schedule of) the protocol without changing what it
+    decides.
     """
     n = 1 + n_replicas + 1
     client = n - 1
@@ -326,6 +346,42 @@ def make_kvchaos(
         )
         return new, eb.build()
 
+    if army_probes < 1:
+        raise ValueError(f"army_probes must be >= 1, got {army_probes}")
+
+    def on_areq(ctx):
+        # army op arrival at the client (a ClientArmy pool row): mark
+        # the invoke and open the session — args[1] carries the number
+        # of probe rounds still owed after this one. No retries — an
+        # open-loop client does not slow down (or re-offer) because
+        # the system is struggling; a lost probe is an op that never
+        # completes, which is exactly the tail signal.
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.lat_start(op_id)
+        eb.send(
+            PRIMARY, user_kind(_H_APROBE),
+            (op_id, jnp.int32(army_probes - 1)),
+        )
+        return ctx.state, eb.build()
+
+    def on_aprobe(ctx):
+        # the authority echoes the session's remaining-round count: a
+        # read-only probe — protocol state is never written here
+        eb = ctx.emits()
+        eb.send(client, user_kind(_H_ARESP), (ctx.args[0], ctx.args[1]))
+        return ctx.state, eb.build()
+
+    def on_aresp(ctx):
+        op_id, k = ctx.args[0], ctx.args[1]
+        eb = ctx.emits()
+        # rounds remaining: chain the next probe; 0 = session complete
+        eb.send(
+            PRIMARY, user_kind(_H_APROBE), (op_id, k - 1), when=k > 0
+        )
+        eb.lat_end(op_id, when=k == 0)
+        return ctx.state, eb.build()
+
     # capacity sizing (see HistorySpec docstring): per write exactly one
     # invoke + one response + one read invoke + at most one read
     # response = 4 records; nothing else records
@@ -348,18 +404,25 @@ def make_kvchaos(
     name = "kvchaos-payload" if payload else "kvchaos"
     if record:
         name += "-bug" if bug else "-record"
+    if army:
+        name += "-army"
+    handler_names = (
+        "init", "write", "repl", "ack", "commit", "retx", "cretx",
+        "fin", "join", "jretx", "read", "readresp",
+    )
+    handlers = (
+        on_init, on_write, on_repl, on_ack, on_commit, on_retx,
+        on_cretx, on_fin, on_join, on_jretx, on_read, on_readresp,
+    )
+    if army:
+        handler_names += ("areq", "aprobe", "aresp")
+        handlers += (on_areq, on_aprobe, on_aresp)
     return Workload(
         name=name,
-        handler_names=(
-            "init", "write", "repl", "ack", "commit", "retx", "cretx",
-            "fin", "join", "jretx", "read", "readresp",
-        ),
+        handler_names=handler_names,
         n_nodes=n,
         state_width=width,
-        handlers=(
-            on_init, on_write, on_repl, on_ack, on_commit, on_retx,
-            on_cretx, on_fin, on_join, on_jretx, on_read, on_readresp,
-        ),
+        handlers=handlers,
         # on_init builds up to 5 rows (write/cretx + join/jretx + 2 chaos);
         # on_retx builds n_replicas+2
         max_emits=max(n_replicas + 2, 6),
@@ -369,15 +432,44 @@ def make_kvchaos(
         args_words=2,
         payload_words=2 if payload else 0,
         history=hist,
+        # army mode: at most one lat_start OR lat_end per invocation
+        lat_markers=1 if army else 0,
+    )
+
+
+def client_army(
+    n_ops: int = 256,
+    t_min_ns: int = 20_000_000,
+    t_max_ns: int = 400_000_000,
+    n_replicas: int = 4,
+    op_base: int = 0,
+):
+    """A :class:`chaos.ClientArmy` bound to kvchaos's client surface
+    (``make_kvchaos(army=True)`` with the same ``n_replicas``): ops
+    arrive at the client node and probe the primary. Compose it into a
+    ``FaultPlan`` next to the chaos specs and run the sweep with
+    ``latency=LatencySpec(ops >= op_base + n_ops)``."""
+    from ..chaos.plan import ClientArmy
+
+    return ClientArmy(
+        node=1 + n_replicas,  # [primary, replicas 1..R, client R+1]
+        kind=user_kind(_H_AREQ),
+        n_ops=n_ops,
+        t_min_ns=t_min_ns,
+        t_max_ns=t_max_ns,
+        op_base=op_base,
     )
 
 
 def lint_entries():
     """Tracing entry points for the static non-interference matrix
     (madsim_tpu.lint); the payload variant rides along so the proof
-    covers the payload-arena trace fold too."""
+    covers the payload-arena trace fold too, and the army variant so
+    the latency-marker path (lat_start/lat_end writes) proves isolated
+    under the latency build axis."""
     kw = dict(pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
     return [
         ("kvchaos/plain", make_kvchaos(), kw),
         ("kvchaos/record", make_kvchaos(record=True, payload=True), kw),
+        ("kvchaos/army", make_kvchaos(army=True), kw),
     ]

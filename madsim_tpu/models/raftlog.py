@@ -75,6 +75,9 @@ _H_APPEND = 4  # args = (term, idx, leader_commit, leader); pay = full log
 _H_ACKAPP = 5  # args = (term, idx, follower)
 _H_PROPOSE = 6  # leader propose timer; args = (term,)
 _H_RETX = 7  # leader retransmit timer; args = (term,)
+_H_AREQ = 8  # at client: army op arrival, args = (op_id, word) — army mode
+_H_APROBE = 9  # at server: army probe, args = (op_id,)
+_H_ARESP = 10  # at client: army response, args = (op_id, commit)
 
 ROLE, TERM, VOTED, VOTES, TSEQ, LOGLEN, COMMIT, ACKS = range(8)
 LOG0 = 8
@@ -105,6 +108,7 @@ def make_raftlog(
     durable: bool = False,
     record: bool = False,
     bug: str | None = None,
+    army: bool = False,
 ) -> Workload:
     """``record=True`` turns on operation-history recording
     (madsim_tpu.check): every election win records an ``OP_ELECT`` event
@@ -159,7 +163,18 @@ def make_raftlog(
     proposals — and retries after the window, so correctness holds
     under EIO storms by design. All the gates read a flag that is
     constant False on fault-free runs, keeping those trajectories (and
-    the oracle compare) bit-identical."""
+    the oracle compare) bit-identical.
+
+    ``army=True`` appends one CLIENT node (index ``n_nodes`` — the
+    servers stay 0..n_nodes-1 and the raft protocol never addresses
+    it) and opens the client surface for open-loop load
+    (``client_army`` builds the matching ``chaos.ClientArmy``): each
+    arriving op probes server ``op_id % n_nodes``, which answers with
+    its commit index — a dirty read, deliberately: it always completes
+    while the probed server is up, so the measured RTT isolates the
+    *transport and scheduling* tail (gray-failure slow links, pause
+    storms) from leader-election availability. Probes to a dead server
+    never complete — incomplete ops ARE the unavailability signal."""
     if bug not in (None, "nosync"):
         raise ValueError(f"unknown raftlog bug {bug!r} (only 'nosync')")
     if bug and not durable:
@@ -169,6 +184,11 @@ def make_raftlog(
         )
     majority = n_nodes // 2 + 1
     nodes = list(range(n_nodes))
+    # army mode appends the client node AFTER the servers: the raft
+    # loops above iterate `nodes` (servers only), so protocol traffic,
+    # elections and the chaos kill draw never touch it
+    n_total = n_nodes + (1 if army else 0)
+    client = n_nodes
     w = n_writes
     width = LOG0 + w
     # the correct placement syncs every durable write in the dispatch
@@ -209,14 +229,20 @@ def make_raftlog(
 
     def on_init(ctx):
         eb = ctx.emits()
-        _arm_election(ctx, eb, jnp.int32(1), True)
+        # the army client (node n_nodes) runs no raft: no election
+        # timer, no records. A constant True for army-off builds, so
+        # pre-army trajectories are untouched.
+        is_server = (
+            ctx.node < jnp.int32(n_nodes) if army else jnp.asarray(True)
+        )
+        _arm_election(ctx, eb, jnp.int32(1), is_server)
         if rec_store:
             # a re-init at now > 0 is a restarted node reading its disk
             # back: record what log length it recovered with (the
             # recovery_safety detector floors this against OP_SYNCED)
             eb.record(
                 OP_RECOVER, key=0, arg=ctx.state[LOGLEN],
-                when=ctx.now > 0,
+                when=(ctx.now > 0) & is_server,
             )
         if chaos:
             # node 0's t=0 init schedules the seed's chaos plan (exactly
@@ -495,17 +521,52 @@ def make_raftlog(
         )
         return ctx.state, eb.build()
 
+    def on_areq(ctx):
+        # army op arrival at the client (a ClientArmy pool row): mark
+        # the invoke and probe one server, round-robin by op id. No
+        # retries — open-loop clients never slow their offered load to
+        # match a struggling system (that feedback is exactly what
+        # hides the tail).
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.lat_start(op_id)
+        eb.send(op_id % jnp.int32(n_nodes), user_kind(_H_APROBE), (op_id,))
+        return ctx.state, eb.build()
+
+    def on_aprobe(ctx):
+        # a dirty read: any live server answers with its commit index
+        # (read-only — raft state is never written on this path)
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.send(client, user_kind(_H_ARESP), (op_id, ctx.state[COMMIT]))
+        return ctx.state, eb.build()
+
+    def on_aresp(ctx):
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.lat_end(op_id)
+        return ctx.state, eb.build()
+
+    handler_names = (
+        "init", "timeout", "reqvote", "grant", "append", "ackapp",
+        "propose", "retx",
+    )
+    handlers = (
+        on_init, on_timeout, on_reqvote, on_grant, on_append,
+        on_ackapp, on_propose, on_retx,
+    )
+    if army:
+        handler_names += ("areq", "aprobe", "aresp")
+        handlers += (on_areq, on_aprobe, on_aresp)
     return Workload(
         name="raftlog"
         + ("-nosync" if bug == "nosync" else "")
-        + ("-record" if record else ""),
-        handler_names=("init", "timeout", "reqvote", "grant", "append", "ackapp", "propose", "retx"),
-        n_nodes=n_nodes,
+        + ("-record" if record else "")
+        + ("-army" if army else ""),
+        handler_names=handler_names,
+        n_nodes=n_total,
         state_width=width,
-        handlers=(
-            on_init, on_timeout, on_reqvote, on_grant, on_append,
-            on_ackapp, on_propose, on_retx,
-        ),
+        handlers=handlers,
         # widest: on_grant = N gated append rows + propose + retx timers
         max_emits=n_nodes + 2,
         payload_words=w,
@@ -538,6 +599,32 @@ def make_raftlog(
             if record
             else None
         ),
+        # army mode: at most one lat_start OR lat_end per invocation
+        lat_markers=1 if army else 0,
+    )
+
+
+def client_army(
+    n_ops: int = 256,
+    t_min_ns: int = 20_000_000,
+    t_max_ns: int = 400_000_000,
+    n_nodes: int = 5,
+    op_base: int = 0,
+):
+    """A :class:`chaos.ClientArmy` bound to raftlog's client surface
+    (``make_raftlog(army=True)`` with the same ``n_nodes``): ops arrive
+    at the appended client node and probe server ``op_id % n_nodes``.
+    Compose it into a ``FaultPlan`` next to the chaos specs and run
+    with ``latency=LatencySpec(ops >= op_base + n_ops)``."""
+    from ..chaos.plan import ClientArmy
+
+    return ClientArmy(
+        node=n_nodes,  # the appended client node
+        kind=user_kind(_H_AREQ),
+        n_ops=n_ops,
+        t_min_ns=t_min_ns,
+        t_max_ns=t_max_ns,
+        op_base=op_base,
     )
 
 

@@ -23,8 +23,14 @@ zero-size arrays and bit-identical values):
   progress for exploration campaigns and soaks, and ``explain``: the
   per-violation narrative interleaving timeline, history ops and the
   checker verdict.
+* **tail latency** (obs/latency.py) — device-side reduction of the
+  engine's per-seed log-linear latency sketches (``LatencySpec`` +
+  ``chaos.ClientArmy`` open-loop load): per-window p50/p90/p99/p999 +
+  max for the whole fleet with only (P, B)-shaped transfer, exactly
+  mergeable across shards (``parallel.merge_latency``).
 
-Evidence artifact: ``tools/obs_soak.py`` (OBS_r09.txt).
+Evidence artifacts: ``tools/obs_soak.py`` (OBS_r09.txt),
+``tools/latency_soak.py`` (LATENCY_r12.txt).
 """
 
 from ..engine.core import (  # noqa: F401 — the slot layout obs consumes
@@ -36,6 +42,17 @@ from ..engine.core import (  # noqa: F401 — the slot layout obs consumes
     METRIC_NAMES,
     N_METRICS,
 )
+from ..engine.core import (  # noqa: F401 — the ladder obs consumes
+    LAT_EDGES_NS,
+    N_LAT_BUCKETS,
+    LatencySpec,
+)
+from .latency import (  # noqa: F401
+    FleetLatency,
+    fleet_latency,
+    hist_quantile_bucket,
+    latency_reduce,
+)
 from .metrics import FleetMetrics, fleet_metrics, fleet_reduce  # noqa: F401
 from .perfetto import to_perfetto, write_perfetto  # noqa: F401
 from .telemetry import JsonlSink, explain, explain_diff  # noqa: F401
@@ -46,15 +63,22 @@ from .timeline import (  # noqa: F401
 )
 
 __all__ = [
+    "FleetLatency",
     "FleetMetrics",
     "JsonlSink",
+    "LAT_EDGES_NS",
+    "LatencySpec",
     "METRIC_NAMES",
+    "N_LAT_BUCKETS",
     "N_METRICS",
     "decode_timeline",
     "explain",
     "explain_diff",
+    "fleet_latency",
     "fleet_metrics",
     "fleet_reduce",
+    "hist_quantile_bucket",
+    "latency_reduce",
     "refold_timeline",
     "timeline_counts",
     "to_perfetto",

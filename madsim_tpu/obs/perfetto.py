@@ -7,12 +7,12 @@ of ``engine.replay.ReplayEvent``) into the Trace Event JSON format that
 * one **process track per node** — every dispatched event at that node
   is a slice, named by the workload's handler table;
 * **message flow arrows** — each delivered message draws a flow from
-  the sending node's track to the delivery slice. The engine records
-  deliveries, not sends, so the arrow anchors at the sender's last
-  dispatch at-or-before the delivery — the latest moment the send can
-  have been emitted (exact when the sender emitted it from that
-  dispatch, which is the overwhelmingly common case; a conservative
-  visual approximation otherwise);
+  the sending node's track to the delivery slice. Rings captured with
+  the emit-time sidecar (``ReplayEvent.emit_ns``, engine ``ev_emit``/
+  ``tl_emit``) anchor the arrow at the TRUE send time — the dispatch
+  that emitted the message. Older captures (``emit_ns < 0``) fall back
+  to the historical approximation: the sender's last dispatch
+  at-or-before the delivery;
 * **chaos spans** — kill/restart, pause/resume, clog/unclog (node,
   link, and one-way forms), slow/unslow, dup on/off, and disk-fault
   (lying-fsync / torn-write) window pairs from the dispatched stream
@@ -178,10 +178,24 @@ def to_perfetto(
             },
         }
         out.append(row)
-        # message flow arrow: sender's last dispatch at-or-before this
-        # delivery -> this slice (see module docstring for the anchor
-        # approximation)
-        if e.src >= 0 and e.src in last_idx_at_node:
+        # message flow arrow: anchored at the TRUE send time when the
+        # ring captured the emit-time sidecar (emit_ns >= 0); else the
+        # sender's last dispatch at-or-before this delivery (see the
+        # module docstring)
+        emit_ns = getattr(e, "emit_ns", -1)
+        if e.src >= 0 and emit_ns >= 0:
+            out.append({
+                "ph": "s", "cat": "flow", "id": flow_id,
+                "name": f"msg n{e.src}->n{e.node}",
+                "pid": e.src, "tid": 0, "ts": _us(emit_ns),
+            })
+            out.append({
+                "ph": "f", "cat": "flow", "id": flow_id, "bp": "e",
+                "name": f"msg n{e.src}->n{e.node}",
+                "pid": pid, "tid": 0, "ts": _us(e.time_ns),
+            })
+            flow_id += 1
+        elif e.src >= 0 and e.src in last_idx_at_node:
             s = events[last_idx_at_node[e.src]]
             out.append({
                 "ph": "s", "cat": "flow", "id": flow_id,

@@ -104,7 +104,8 @@ _CAPTURE_CACHE: dict = {}
 _CAPTURE_CACHE_MAX = 8
 
 
-def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout):
+def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout,
+             latency=None):
     """Re-run one (seed, plan) with the forensics taps on: a field-name
     view dict of the final state plus the literalized plan (or None)."""
     seeds = np.asarray([seed], np.uint64)
@@ -112,18 +113,19 @@ def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout):
         rows, slots, dup, lit = _plan_rows_for(plan, seed)
     else:
         rows, slots, dup, lit = None, 0, False, None
-    key = (id(wl), cfg.hash(), max_steps, timeline_cap, layout, slots, dup)
+    key = (id(wl), cfg.hash(), max_steps, timeline_cap, layout, slots, dup,
+           latency)
     if key not in _CAPTURE_CACHE:
         while len(_CAPTURE_CACHE) >= _CAPTURE_CACHE_MAX:
             _CAPTURE_CACHE.pop(next(iter(_CAPTURE_CACHE)))
         _CAPTURE_CACHE[key] = (
             make_init(
                 wl, cfg, plan_slots=slots, metrics=True,
-                timeline_cap=timeline_cap,
+                timeline_cap=timeline_cap, latency=latency,
             ),
             jax.jit(make_run_while(
                 wl, cfg, max_steps, layout=layout, dup_rows=dup,
-                metrics=True, timeline_cap=timeline_cap,
+                metrics=True, timeline_cap=timeline_cap, latency=latency,
             )),
             wl,  # keep the workload alive so id() stays unique
         )
@@ -148,6 +150,7 @@ def explain(
     timeline_cap: int = 1024,
     layout: str | None = None,
     max_events: int = 200,
+    latency=None,
 ) -> str:
     """Narrate one ``(seed, plan)`` run: timeline + history + verdict.
 
@@ -158,8 +161,14 @@ def explain(
     either the narrative reports the run without judging it.
     ``max_events`` bounds the printed timeline (the middle is elided;
     the head establishes context, the tail holds the crash site).
+    ``latency`` (an ``engine.LatencySpec``) re-runs with the
+    tail-latency tap on and adds the latency section: per-window
+    percentiles off the seed's own sketch plus the slowest completed
+    ops — the narrative an SLO breach needs.
     """
-    view, lit = _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout)
+    view, lit = _capture(
+        wl, cfg, seed, plan, max_steps, timeline_cap, layout, latency
+    )
 
     lines = [
         f"=== explain: {wl.name!r} seed {int(seed)} "
@@ -244,6 +253,9 @@ def explain(
             f"dropped — checker verdicts are void for this seed"
         )
 
+    if latency is not None and view["lat_hist"].shape[2]:
+        lines.extend(_latency_section(view, latency))
+
     verdicts = []
     if invariant is not None:
         ok = bool(np.asarray(invariant(view))[0])
@@ -264,6 +276,46 @@ def explain(
         + f" trace={int(view['trace'][0]):#018x}"
     )
     return "\n".join(lines)
+
+
+def _latency_section(view, latency) -> list:
+    """The tail-percentile narrative of one seed's sketch columns."""
+    from ..engine.core import lat_bucket_hi
+    from .latency import hist_quantile_bucket
+
+    inv = view["lat_inv"][0]
+    resp = view["lat_resp"][0]
+    hist = view["lat_hist"][0]  # (P, B)
+    invoked = int((inv >= 0).sum())
+    completed = int(view["lat_count"][0])
+    lines = [
+        f"--- latency: {invoked} op(s) invoked, {completed} completed, "
+        f"{invoked - completed} never answered"
+        + (f", {int(view['lat_drop'][0])} marker(s) DROPPED "
+           f"(op id out of range)" if int(view["lat_drop"][0]) else "")
+    ]
+    for p in range(hist.shape[0]):
+        h = hist[p]
+        n = int(h.sum())
+        if not n:
+            continue
+        qs = []
+        for q in (0.50, 0.90, 0.99):
+            b = int(hist_quantile_bucket(h, q))
+            qs.append(f"p{int(q * 100)}<={int(lat_bucket_hi(b)) / 1e6:.2f}ms")
+        t0 = p * latency.phase_ns / 1e6
+        lines.append(
+            f"    window [{t0:.0f}ms..): {n} ops, " + ", ".join(qs)
+        )
+    done = np.flatnonzero((inv >= 0) & (resp >= 0))
+    if done.size:
+        d = (resp[done] - inv[done]).astype(np.int64)
+        worst = done[np.argsort(d)[::-1][:5]]
+        tops = ", ".join(
+            f"op{int(i)}={int(resp[i] - inv[i]) / 1e6:.2f}ms" for i in worst
+        )
+        lines.append(f"    slowest completed: {tops}")
+    return lines
 
 
 def _fmt_event(e, wl) -> str:

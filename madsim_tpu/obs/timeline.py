@@ -61,6 +61,14 @@ def decode_timeline(view, wl: Workload | None = None, seed: int = 0) -> list:
         raise ValueError(
             "state carries no timeline columns — run with timeline_cap > 0"
         )
+    # emit-time sidecar: rings captured before the sidecar existed (or
+    # views that dropped the column) decode with emit_ns = -1
+    try:
+        emit = np.asarray(_get(view, "tl_emit"))[seed]
+        if emit.shape[0] == 0:
+            emit = None
+    except (KeyError, AttributeError):
+        emit = None
     events = []
     for i in range(count):
         m = int(meta[i])
@@ -72,6 +80,7 @@ def decode_timeline(view, wl: Workload | None = None, seed: int = 0) -> list:
                 src=((m >> 16) & 0xFF) - 1,
                 args=tuple(int(x) for x in args[i]),
                 pay=tuple(int(x) for x in pay[i]),
+                emit_ns=int(emit[i]) if emit is not None else -1,
             )
         )
     return events

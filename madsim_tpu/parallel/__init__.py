@@ -44,6 +44,7 @@ _SM_NOCHECK = (
 __all__ = [
     "make_mesh",
     "merge_coverage",
+    "merge_latency",
     "merge_metrics",
     "seed_sharding",
     "shard_state",
@@ -173,6 +174,43 @@ def merge_metrics(met, mesh: Mesh | None = None) -> np.ndarray:
     return np.asarray(per_dev, np.int64).sum(axis=0)
 
 
+def merge_latency(lat_hist, mesh: Mesh | None = None) -> np.ndarray:
+    """Sum-fold per-seed latency sketches (S, P, B) into (P, B) totals.
+
+    The tail analog of :func:`merge_metrics`: with a ``mesh``, each
+    device sums its local seed shard (``shard_map``, zero cross-device
+    traffic) and only device-count sketch pages reach the host — the
+    ladder histogram is *exactly mergeable* (integer addition), so the
+    sharded fold equals the sketch of the concatenated batch bit for
+    bit, which is what lets pod-scale campaigns keep fleet tail
+    analysis device-resident. int64 accumulation so 32-bit per-seed
+    counts cannot overflow the fleet sum.
+    """
+    import jax.numpy as jnp
+
+    hh = jnp.asarray(lat_hist)
+    if hh.ndim != 3:
+        raise ValueError(f"lat_hist must be (S, P, B), got shape {hh.shape}")
+
+    def fold(h):
+        return jnp.sum(h.astype(jnp.int64), axis=0)
+
+    if mesh is None:
+        return np.asarray(jax.jit(fold)(hh))
+    n_dev = mesh.devices.size
+    if hh.shape[0] % n_dev:
+        raise ValueError(
+            f"{hh.shape[0]} sketch rows do not split over {n_dev} devices"
+        )
+    spec = P(mesh.axis_names)
+    local = lambda h: fold(h)[None]  # noqa: E731 — (1, P, B) per device
+    per_dev = jax.jit(
+        _shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec,
+                   **_SM_NOCHECK)
+    )(hh)
+    return np.asarray(per_dev, np.int64).sum(axis=0)
+
+
 def shard_run_compacted(
     wl,
     cfg,
@@ -183,6 +221,7 @@ def shard_run_compacted(
     shrink: int = 4,
     min_size: int = 2048,
     fields: tuple | None = None,
+    latency=None,
 ):
     """Multi-chip form of :func:`engine.make_run_compacted`.
 
@@ -205,7 +244,7 @@ def shard_run_compacted(
     kw = {} if fields is None else {"fields": fields}
     base = _compact.make_run_compacted(
         wl, cfg, max_steps, layout, time32, shrink=shrink,
-        min_size=min_size, **kw,
+        min_size=min_size, latency=latency, **kw,
     )
     n_dev = mesh.devices.size
     spec = P(mesh.axis_names)

@@ -201,6 +201,48 @@ class TestNonInterference:
         )
         rep.to_json()  # must serialize
 
+    def test_latency_columns_isolated_dense_and_time32(self):
+        """The new lowering axes of the matrix (dense one-hot writes,
+        int32 pool times) and the latency-marker path: the army
+        model's lat_* columns (and the emit-time sidecar) prove
+        isolated in the exact programs a TPU runs. The full sweep is
+        the slow matrix / make lint --jaxpr."""
+        from madsim_tpu.engine import LatencySpec
+        from madsim_tpu.models.kvchaos import make_kvchaos
+
+        wl = make_kvchaos(army=True)
+        spec = LatencySpec(ops=8, phases=2)
+        for layout, t32 in (("dense", False), ("dense", True)):
+            rep = check_noninterference(
+                wl, CFG, layout=layout, time32=t32, latency=spec,
+                timeline_cap=8, cov_words=8,
+            )
+            assert rep.ok, rep.summary()
+            assert "lat_hist" in rep.out_taint
+            rep.to_json()  # LatencySpec flags stay JSON-able
+
+    def test_layout_axes_sweep_and_time32_skip(self):
+        from madsim_tpu.lint import check_matrix
+        from madsim_tpu.lint.noninterference import LAYOUT_AXES
+
+        assert ("dense", False) in LAYOUT_AXES
+        assert ("scatter", True) in LAYOUT_AXES
+        # the combined pair is the exact program an accelerator runs
+        assert ("dense", True) in LAYOUT_AXES
+        # a non-eligible (workload, config) is skipped for time32
+        # pairs instead of failing the matrix
+        wl = make_raft()
+        wl = type(wl)(**{
+            **{f.name: getattr(wl, f.name) for f in
+               __import__("dataclasses").fields(wl)},
+            "delay_bound_ns": None,
+        })
+        reps = check_matrix(
+            [("raft/unbounded", wl, CFG)], {"base": {}},
+            layouts=(("scatter", True),),
+        )
+        assert reps == []
+
     @pytest.mark.slow
     def test_full_matrix(self):
         # the acceptance sweep: four recorded models (plus the durable
@@ -299,6 +341,30 @@ class TestLintRules:
         )
         # id() outside a branch condition is not flagged
         assert "id-hash-branch" not in self._rules("k = id(object())\n")
+
+    def test_fixed_key_scoped_to_sim_code(self):
+        src = "import jax\nk = jax.random.PRNGKey(0)\n"
+        hits = [
+            f.rule for f in lint_source(src, "m.py", **SIM).findings
+        ]
+        assert "fixed-key" in hits
+        # a derived (non-constant) key is the sanctioned construction
+        ok = "import jax\nk = jax.random.PRNGKey(seed)\n"
+        assert not lint_source(ok, "m.py", **SIM).findings
+        # host-side tools may seed however they like
+        assert not lint_source(src, "t.py", sim_code=False).findings
+        # the alias + jax.random.key spelling resolves too
+        src2 = "from jax import random as jr\nk = jr.key(42)\n"
+        assert "fixed-key" in [
+            f.rule for f in lint_source(src2, "m.py", **SIM).findings
+        ]
+        # pragma allowlists an intentional fixed key
+        src3 = (
+            "import jax\n"
+            "k = jax.random.PRNGKey(0)  # lint: allow(fixed-key)\n"
+        )
+        res = lint_source(src3, "m.py", **SIM)
+        assert not res.findings and res.allowed
 
     def test_host_callback_scoped_to_sim_code(self):
         src = (
@@ -420,7 +486,7 @@ class TestSyncEio:
 
         spec = DiskFault(targets=(0, 1), n_torn=1, n_sync_loss=1, n_eio=2)
         assert spec.slots == 8
-        time, kinds, args, _valid = spec.compile_batch(
+        time, kinds, args, _valid, _node = spec.compile_batch(
             np.arange(4, dtype=np.uint64), slot=0
         )
         on = np.asarray(kinds) == KIND_SYNC_LOSS

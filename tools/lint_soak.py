@@ -5,9 +5,11 @@ Four certificates:
 
 1. **Non-interference matrix** — the four recorded models (raft,
    kvchaos, paxos, raftlog; each with history recording on and off,
-   raftlog additionally with the disk discipline on) x every
-   observability build axis (base / metrics / timeline / coverage /
-   hit-count / all), traced via the single-seed step AND the vmapped
+   kvchaos additionally with the client-army latency markers, raftlog
+   additionally with the disk discipline on) x every observability
+   build axis (base / metrics / timeline / coverage / hit-count /
+   latency / all) x every lowering pair (scatter/int64, dense, time32
+   where eligible), traced via the single-seed step AND the vmapped
    ``make_run`` scan path: every derived column provably isolated from
    every core column and the trace fold.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
@@ -38,7 +40,10 @@ from madsim_tpu.lint import (  # noqa: E402
     lint_source,
     plant_met_leak,
 )
-from madsim_tpu.lint.noninterference import BUILD_AXES  # noqa: E402
+from madsim_tpu.lint.noninterference import (  # noqa: E402
+    BUILD_AXES,
+    LAYOUT_AXES,
+)
 from madsim_tpu.engine import EngineConfig  # noqa: E402
 from madsim_tpu.models import make_raft  # noqa: E402
 
@@ -51,7 +56,7 @@ def main() -> None:
     # ---- certificate 1: the full non-interference matrix ----
     t0 = time.monotonic()  # lint: allow(wall-clock)
     print("== cert 1: jaxpr non-interference, model x build-flag matrix ==")
-    reports = check_matrix(log=lambda s: print(f"  {s}"))
+    reports = check_matrix(layouts=LAYOUT_AXES, log=lambda s: print(f"  {s}"))
     bad = [r for r in reports if not r.ok]
     n_eqns = sum(r.n_eqns for r in reports)
     print(f"  step-entry matrix: {len(reports)} proofs, "
@@ -124,6 +129,7 @@ def main() -> None:
             "from jax.experimental import io_callback\n"
             "io_callback(print, None, 1)\n"
         ),
+        "fixed-key": "import jax\nk = jax.random.PRNGKey(0)\n",
         "unused-allow": "x = 1  # lint: allow(np-random)\n",
     }
     rules_ok = True

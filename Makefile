@@ -25,7 +25,7 @@ SHELL   := /bin/bash
 
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
-        store-soak latency-soak lint lint-soak clean
+        store-soak latency-soak lint lint-soak profile clean
 
 check: native lint test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -43,6 +43,18 @@ lint:
 
 lint-soak:
 	$(PY) tools/lint_soak.py
+
+# Per-config step profile (tools/profile_step.py): phase wall
+# breakdown by ablation differencing + XLA's HLO cost analysis, one
+# JSONL row per bench config — the attribution evidence behind any
+# perf claim (replaces the hand-run PROFILE_CPU_r05 flow). Pure
+# measurement, never part of tier-1. PROFILE_OUT / PROFILE_CONFIGS
+# override the artifact name and the config list.
+PROFILE_OUT     ?= PROFILE_CPU_r06.jsonl
+PROFILE_CONFIGS ?=
+profile:
+	$(PY) tools/profile_step.py $(PROFILE_CONFIGS) > $(PROFILE_OUT)
+	@cat $(PROFILE_OUT)
 
 native:
 	$(MAKE) -C native

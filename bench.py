@@ -108,9 +108,16 @@ def probe_platform(timeout: float) -> tuple[str, str]:
 def parent() -> None:
     budget = float(os.environ.get("BENCH_BUDGET", "1500"))
     per_cfg_cap = float(os.environ.get("BENCH_CONFIG_TIMEOUT", "600"))
+    # Probe timeout: a healthy accelerator answers the probe op in a few
+    # seconds; a wedged tunnel HANGS (not fails), so every second spent
+    # waiting is pure wall burned before the CPU fallback starts —
+    # BENCH_r05 lost 120 s to exactly this before the staged retry.
+    # 45 s is ample for a cold TPU init; override via BENCH_PROBE_TIMEOUT
+    # for exotic targets.
+    probe_cap = float(os.environ.get("BENCH_PROBE_TIMEOUT", "45"))
     t_start = time.monotonic()  # lint: allow(wall-clock)
 
-    mode, platform = probe_platform(timeout=min(120.0, budget / 4))
+    mode, platform = probe_platform(timeout=min(probe_cap, budget / 4))
     print(f"# probe: mode={mode} platform={platform}", file=sys.stderr)
 
     results = {}
@@ -162,7 +169,9 @@ def parent() -> None:
     # runs only, never a replay of stale numbers.
     remaining = budget - (time.monotonic() - t_start)  # lint: allow(wall-clock)
     if mode == "cpu" and remaining > 180:
-        retry_mode, retry_platform = probe_platform(timeout=min(120.0, remaining / 3))
+        retry_mode, retry_platform = probe_platform(
+            timeout=min(probe_cap, remaining / 3)
+        )
         print(
             f"# staged retry probe: mode={retry_mode} platform={retry_platform}",
             file=sys.stderr,
@@ -304,7 +313,12 @@ def child(config: str) -> None:
 
     import numpy as np
 
-    from madsim_tpu.engine import EngineConfig, make_init, make_run_compacted
+    from madsim_tpu.engine import (
+        EngineConfig,
+        make_init,
+        make_run_compacted,
+        time32_eligible,
+    )
     from madsim_tpu.models import BENCH_SPECS
 
     n_seeds = int(os.environ.get("BENCH_SEEDS", "8192"))
@@ -314,7 +328,13 @@ def child(config: str) -> None:
     factory, cfg_kwargs, _spec_seeds, _spec_steps = BENCH_SPECS[config]
     wl, cfg = factory(), EngineConfig(**cfg_kwargs)
 
-    init = make_init(wl, cfg)
+    # int32 event times whenever the (workload, config) bounds allow:
+    # a value-identical lowering (test-pinned against int64), already
+    # the accelerator default, and measured ~8% faster on CPU too —
+    # the bench quotes the engine's fastest value-identical program,
+    # exactly as it does for layout and compaction
+    t32 = True if time32_eligible(wl, cfg) else None
+    init = make_init(wl, cfg, time32=t32)
 
     # one min_size policy for BOTH platforms, so a config's accelerator
     # and CPU numbers describe the same compaction program
@@ -338,7 +358,7 @@ def child(config: str) -> None:
         # into multi-second dispatches, median wall-per-sim).
         from madsim_tpu.engine.measure import measure_latency
 
-        rec = measure_latency(wl, cfg, n_steps, seed_mod=seed_mod)
+        rec = measure_latency(wl, cfg, n_steps, seed_mod=seed_mod, time32=t32)
         if rec["overflow"] or not rec["all_halted"]:
             print(
                 json.dumps(
@@ -376,7 +396,7 @@ def child(config: str) -> None:
         # wall is ~CPU_CELL_TARGET_S (capped at the spec seed count) —
         # measure_throughput then packs repeats if the batch is shorter
         run = make_run_compacted(
-            wl, cfg, n_steps,
+            wl, cfg, n_steps, time32=t32,
             min_size=_min_size(CPU_CALIBRATE_SEEDS), fields=("now",),
         )
         jax.block_until_ready(
@@ -403,7 +423,7 @@ def child(config: str) -> None:
         wl, cfg, n_steps, n_seeds,
         target_wall_s=5.0 if accel else 3.5,
         n_measure=5 if accel else 3,
-        seed_mod=seed_mod, min_size=_min_size(n_seeds),
+        seed_mod=seed_mod, min_size=_min_size(n_seeds), time32=t32,
     )
     # the small pool sizes are only valid while nothing overflows; a
     # silent drop would skew the metric. Reported as a distinct

@@ -126,6 +126,7 @@ def make_run_compacted(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency=None,
+    placement: str | None = None,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -141,7 +142,7 @@ def make_run_compacted(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount, latency,
+        metrics, timeline_cap, cov_hitcount, latency, placement,
     ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:

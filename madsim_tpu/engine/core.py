@@ -66,6 +66,7 @@ from .rng import (
     PURPOSE_LOSS,
     PURPOSE_POLL_COST,
     PURPOSE_TORN,
+    PURPOSE_USER,
     Draw,
     chance_threshold,
 )
@@ -131,6 +132,15 @@ __all__ = [
 _INF_NS = np.int64(2**62)
 _INF_32 = np.int32(2**31 - 1)
 _T32_LIMIT = 2**31 - 1  # max future-event offset representable in int32
+
+# Pool-size crossover for the scatter layout's two placement lowerings
+# (see make_step's ``placement``). Rank-matched placement costs fused
+# vector passes over the whole pool (O(E) elements, ~60 ns per pass per
+# seed per 64 slots on CPU); scatter-store placement costs one serial
+# row-update per emit slot (~110 ns per row on XLA CPU, independent of
+# E). Measured crossover sits near E ≈ 1k; 512 keeps headroom for wider
+# emit rows (tools/profile_step.py re-measures it per config).
+_RANK_PLACE_MAX_POOL = 512
 
 # ---------------------------------------------------------------------------
 # ev_meta byte layout. The four small per-event fields travel as one
@@ -758,13 +768,13 @@ class EmitBuilder:
             )
         pad = r - len(self._recs)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_x) in self._recs]
-        rows = [
-            jnp.stack([jnp.asarray(x, jnp.int32) for x in rest])
-            for (_wh, *rest) in self._recs
-        ]
+        words: list = []
+        for (_wh, *rest) in self._recs:
+            words.extend(rest)
+        words += [0] * (pad * 4)
         return (
-            jnp.stack(valid + [jnp.asarray(False)] * pad),
-            jnp.stack(rows + [jnp.zeros((4,), jnp.int32)] * pad),
+            jnp.stack(valid + [False] * pad),
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in words]).reshape(r, 4),
         )
 
     def _build_sync(self):
@@ -782,13 +792,13 @@ class EmitBuilder:
             )
         pad = l - len(self._lats)
         valid = [jnp.asarray(wh, jnp.bool_) for (wh, *_x) in self._lats]
-        rows = [
-            jnp.stack([jnp.asarray(oid, jnp.int32), jnp.int32(ph)])
-            for (_wh, oid, ph) in self._lats
-        ]
+        words: list = []
+        for (_wh, oid, ph) in self._lats:
+            words.extend((oid, ph))
+        words += [0] * (pad * 2)
         return (
-            jnp.stack(valid + [jnp.asarray(False)] * pad),
-            jnp.stack(rows + [jnp.zeros((2,), jnp.int32)] * pad),
+            jnp.stack(valid + [False] * pad),
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in words]).reshape(l, 2),
         )
 
     def build(self) -> Emits:
@@ -969,6 +979,17 @@ class Workload:
     # Marker semantics are derived-state-only: the markers do nothing
     # at all unless the step is built with a LatencySpec.
     lat_markers: int = 0
+    # user purposes to PREFETCH into the per-dispatch batched RNG block
+    # (the BatchRNG shape, PAPERS.md): handler draws at these purposes
+    # (the ints passed to ctx.draw.user/user_int) are served from lanes
+    # of the ONE cipher pass the step already runs, instead of each
+    # branch issuing its own scalar threefry — under vmap the
+    # lax.switch evaluates EVERY branch per dispatch, so each distinct
+    # in-branch cipher is a per-step cost whether or not its branch is
+    # selected. Draw VALUES are bit-identical either way (same
+    # (seed, step, purpose) counter per lane), so this is a pure
+    # declaration of which lanes to batch; None/() changes nothing.
+    draw_purposes: tuple | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -1004,6 +1025,20 @@ class Workload:
             raise ValueError(
                 f"lat_markers must be >= 0, got {self.lat_markers}"
             )
+        if self.draw_purposes is not None:
+            bad = [
+                p for p in self.draw_purposes
+                if not 0 <= int(p) < (1 << 32) - PURPOSE_USER
+            ]
+            if bad:
+                raise ValueError(
+                    f"draw_purposes {bad} out of the user purpose range "
+                    f"[0, 2^32 - {PURPOSE_USER})"
+                )
+            if len(set(self.draw_purposes)) != len(self.draw_purposes):
+                raise ValueError(
+                    f"draw_purposes has duplicates: {self.draw_purposes}"
+                )
         if self.handler_names is not None and len(self.handler_names) != len(
             self.handlers
         ):
@@ -1419,6 +1454,27 @@ def make_init(
 # ---------------------------------------------------------------------------
 
 
+@jax.custom_batching.custom_vmap
+def _materialize(xs):
+    """Identity barrier: force XLA to materialize ``xs`` here.
+
+    Blocks producer fusion across the boundary —
+    ``lax.optimization_barrier`` with the vmap rule the primitive
+    itself lacks (the engine is always used under one ``jax.vmap``
+    over seeds). The rank-placement path uses it to materialize the
+    branch-selected emit rows ONCE: without it XLA fuses the
+    lax.switch select chain into every per-slot placement pass and
+    recomputes it per pool slot (measured 3.2 µs/seed-step in one
+    fusion — a third of the raftlog step, PROFILE_CPU_r06)."""
+    return lax.optimization_barrier(xs)
+
+
+@_materialize.def_vmap
+def _materialize_vmap(axis_size, in_batched, xs):
+    del axis_size
+    return lax.optimization_barrier(xs), in_batched[0]
+
+
 def _trace_fold(trace, now, kind, node, args, pay=None):
     """Fold one dispatched event into the rolling trace hash (uint64)."""
     h = now.astype(jnp.uint64) * _TRACE_MIX
@@ -1449,6 +1505,7 @@ def make_step(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    placement: str | None = None,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -1464,9 +1521,28 @@ def make_step(
       no gather/scatter ops. TPU lowers batched scatter/gather to
       serial loops (measured 96% of step wall time,
       examples/profile_step.py), so dense is ~70x faster there.
-    * ``"scatter"`` — dynamic indexing and ``.at[].set`` scatters, the
-      natural (and faster) lowering on CPU.
+    * ``"scatter"`` — row-indexed: gathers for the per-event reads plus
+      ``placement``-selected pool writes, the fast CPU lowering.
     * ``None`` (default) — scatter on the CPU backend, dense elsewhere.
+
+    ``placement`` picks the scatter layout's pool-WRITE lowering (a
+    third value-identical choice; dense ignores it — its one-hot
+    placement is already rank-matched):
+
+    * ``"rank"`` — rank-matched vector placement: the j-th ready emit
+      pairs with the j-th free slot by cumsum rank, pool columns update
+      through fused gather+select passes and the popped slot is
+      consumed by a masked select. No scatter ops anywhere in the hot
+      path — XLA CPU lowers a batched scatter to a SERIAL per-row
+      update loop (~110 ns/row, measured: ~123 such rows/step were 90%
+      of the pre-PR-8 step wall), while the select forms stay fused
+      vector code.
+    * ``"scatter"`` — the historical ``.at[].set`` stores. Each store
+      costs O(emit rows), independent of pool size, so it WINS once
+      the pool is large (client-army pools, thousands of slots) and
+      the O(E) vector passes dominate instead.
+    * ``None`` (default) — ``"rank"`` when ``cfg.pool_size`` <=
+      ``_RANK_PLACE_MAX_POOL`` (512), else ``"scatter"``.
 
     ``time32`` picks the *representation* of pool event times — again
     value-identical (tests/test_engine.py asserts it):
@@ -1554,6 +1630,8 @@ def make_step(
     # workload, so the whole block compiles away when off
     sync_on = wl.durable_sync
     n_user = len(wl.handlers)
+    # user purposes prefetched into the per-dispatch RNG block (static)
+    user_purposes = tuple(int(p) for p in (wl.draw_purposes or ()))
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
     _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
@@ -1562,6 +1640,17 @@ def make_step(
     if layout not in ("dense", "scatter"):
         raise ValueError(f"unknown layout {layout!r}")
     dense = layout == "dense"
+    if placement is None:
+        placement = (
+            "rank" if cfg.pool_size <= _RANK_PLACE_MAX_POOL else "scatter"
+        )
+    if placement not in ("rank", "scatter"):
+        raise ValueError(f"unknown placement {placement!r}")
+    # rank-matched pool writes (scatter layout only; dense has its own
+    # one-hot placement). Single-row appends (timeline ring, latency
+    # clocks) stay .at[] stores either way — one serial row per step is
+    # exactly the O(1) write a cold-bank append wants.
+    rank_place = (not dense) and placement == "rank"
     time32 = _resolve_time32(wl, cfg, time32)
     t_inf = _INF_32 if time32 else _INF_NS
 
@@ -1574,14 +1663,22 @@ def make_step(
     # lax.switch operands must be pytrees, so the context travels as a
     # tuple of arrays and each branch rebuilds the HandlerCtx view.
     def _unpack(op) -> HandlerCtx:
-        now, node, state, args, src, k0, k1, stp, pay, eio = op
+        now, node, state, args, src, k0, k1, stp, pay, eio, ul0, ul1 = op
+        # prefetched user lanes -> the Draw cache (trace-time dict,
+        # static purposes): a declared purpose's draw reads its lane of
+        # the per-dispatch block instead of running a scalar cipher in
+        # this branch — identical (seed, step, purpose) values
+        cache = {
+            PURPOSE_USER + p: (ul0[j], ul1[j])
+            for j, p in enumerate(user_purposes)
+        } or None
         return HandlerCtx(
             now=now,
             node=node,
             state=state,
             args=args,
             src=src,
-            draw=Draw.from_parts(k0, k1, stp),
+            draw=Draw.from_parts(k0, k1, stp, cache),
             max_emits=k,
             payload=pay,
             payload_words=w,
@@ -1775,12 +1872,44 @@ def make_step(
 
         now = jnp.where(active, ev_t, st.now)
         draw = Draw(st.seed, st.step)
+        # ---- per-dispatch batched RNG (the BatchRNG shape, PAPERS.md).
+        # Every purpose one event-step can draw is enumerated as a
+        # static lane vector and generated by ONE varying-counter
+        # threefry pass (Draw.block2): lane 0 is the poll-cost/jitter
+        # pair, lanes 1..k+1 the per-emit latency/loss pairs (+ the dup
+        # shadow lanes, + the torn-prefix draw under the sync
+        # discipline). Each lane keys the same (seed, step, purpose)
+        # counter the retired per-use calls did, so every draw VALUE —
+        # and therefore every trace and the C++ oracle compare — is
+        # bit-identical; what changes is the cipher running as one
+        # fused vector pass instead of per-use scalar invocations.
+        n_em_lanes = (k + 1) + (k if dup_rows else 0)
+        lane_p = [PURPOSE_POLL_COST]
+        lane_p += [PURPOSE_LATENCY + s for s in range(k + 1)]
+        if dup_rows:
+            lane_p += [PURPOSE_DUP + s for s in range(k)]
+        i_torn = len(lane_p)
+        if sync_on:
+            lane_p.append(PURPOSE_TORN)
+        # user lanes (Workload.draw_purposes): handler draws at these
+        # purposes ride the same block; ctx.draw serves them from a
+        # trace-time lane cache (rng.Draw.from_parts) so no branch
+        # issues its own scalar cipher for a declared purpose
+        i_user = len(lane_p)
+        lane_p += [PURPOSE_USER + p for p in user_purposes]
+        # stacked scalar literals, NOT a literal array: a pallas kernel
+        # (engine/vmem.py) cannot capture non-scalar jaxpr constants,
+        # and scalars inline as literals — same values either way
+        lane0, lane1 = draw.block2(
+            jnp.stack([jnp.uint32(p) for p in lane_p])
+        )
+        user_l0 = lane0[i_user:]
+        user_l1 = lane1[i_user:]
         # per-event processing cost, 50-100 ns (task.rs:213), paired
         # with the clog-recheck jitter in ONE threefry block (lane 0 =
         # cost, lane 1 = jitter) — same bits2 pairing as latency/loss
-        cost, clog_jit = draw.uniform_int2(
-            cfg.proc_min_ns, cfg.proc_max_ns, 0, 1000, PURPOSE_POLL_COST
-        )
+        cost = draw._reduce(lane0[0], cfg.proc_min_ns, cfg.proc_max_ns)
+        clog_jit = draw._reduce(lane1[0], 0, 1000)
         now_after = jnp.where(dispatch, now + cost, now)
 
         # ---- consume / reschedule the popped slot ----
@@ -1815,7 +1944,10 @@ def make_step(
         meta_bumped = (meta_i & jnp.uint32(0x00FFFFFF)) | (
             jnp.minimum(retries + 1, 255).astype(jnp.uint32) << jnp.uint32(24)
         )
-        if dense:
+        if dense or rank_place:
+            # masked selects: the popped slot is consumed (or its
+            # backoff rescheduled) by a fused vector pass — identical
+            # values to the .at[i] store, no serial scatter
             ev_valid_mid = jnp.where(is_popped, resched, st.ev_valid)
             ev_time_mid = jnp.where(is_popped & resched, back_t, ev_time_reb)
             ev_meta_mid = jnp.where(is_popped & resched, meta_bumped, st.ev_meta)
@@ -1841,6 +1973,7 @@ def make_step(
             operand = (
                 user_now, dst, state_row, args, src,
                 draw.k0, draw.k1, draw.step, pay_i, eio_dst,
+                user_l0, user_l1,
             )
             user_state, uem = lax.switch(user_idx, user_branches, operand)
         else:
@@ -1851,7 +1984,10 @@ def make_step(
         # ---- apply node-state update (an OOB dst matches no row in the
         # dense form, exactly the dropped-scatter semantics) ----
         row = jnp.where(user_dispatch, user_state, state_row)
-        if dense:
+        if dense or rank_place:
+            # (an OOB dst has an all-False one-hot — the dropped-scatter
+            # semantics as a select; N*U is small for every model, so
+            # the fused pass beats a serial row store)
             node_state = jnp.where(dst_oh[:, None], row[None, :], st.node_state)
         else:
             # negative indices would wrap (numpy semantics); redirect OOB
@@ -1995,7 +2131,7 @@ def make_step(
             # crash: durable columns revert to the synced image; an
             # armed torn mode persists rank < keep_cnt columns (column
             # order) of the last uncommitted write on top of it
-            torn_bits = draw.bits(PURPOSE_TORN)
+            torn_bits = lane0[i_torn]  # the PURPOSE_TORN lane of the block
             n_dirty = jnp.sum(wmask.astype(jnp.int32), axis=1)  # (N,)
             rank = jnp.cumsum(wmask.astype(jnp.int32), axis=1) - 1
             keep_cnt = (
@@ -2039,15 +2175,14 @@ def make_step(
             rec=uem.rec,
         )
         # one threefry block per emit slot: lane 0 = latency, lane 1 =
-        # loss (Draw.bits2) — halves the per-step block-cipher count.
-        # Under dup_rows, K shadow rows follow the restart row: copies of
-        # the user send slots, valid only while the seed's dup flag is on,
-        # drawing an INDEPENDENT latency/loss block at PURPOSE_DUP+slot —
-        # the duplicated delivery arrives at its own time and is lost on
-        # its own coin, exactly like a real duplicate in flight.
-        purposes = jnp.uint32(PURPOSE_LATENCY) + jnp.arange(
-            k + 1, dtype=jnp.uint32
-        )
+        # loss — the emit slices of the per-dispatch batched block
+        # (Draw.block2 above), bit-identical to the retired per-slot
+        # vmapped cipher. Under dup_rows, K shadow rows follow the
+        # restart row: copies of the user send slots, valid only while
+        # the seed's dup flag is on, drawing an INDEPENDENT latency/loss
+        # pair at the PURPOSE_DUP+slot lane — the duplicated delivery
+        # arrives at its own time and is lost on its own coin, exactly
+        # like a real duplicate in flight.
         if dup_rows:
             dvalid = uem.valid & ~is_engine & uem.send & st.dup
             em = Emits(
@@ -2061,10 +2196,8 @@ def make_step(
                 rec_valid=em.rec_valid,
                 rec=em.rec,
             )
-            purposes = jnp.concatenate(
-                [purposes, jnp.uint32(PURPOSE_DUP) + jnp.arange(k, dtype=jnp.uint32)]
-            )
-        lat_bits, loss_bits = jax.vmap(lambda s: draw.bits2(s))(purposes)
+        lat_bits = lane0[1 : 1 + n_em_lanes]
+        loss_bits = lane1[1 : 1 + n_em_lanes]
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
         if time32:  # same value, native width (lat_max fits by eligibility)
             latency = jnp.int32(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int32)
@@ -2213,6 +2346,62 @@ def make_step(
                 )
             else:
                 ev_emit = st.ev_emit
+        elif rank_place:
+            # rank-matched vector placement: the free slots are the
+            # ready-to-receive partition of the pool, ranked in slot
+            # order by one cumsum; the j-th valid emit pairs with the
+            # j-th free slot exactly like the scatter store and the
+            # dense match matrix. Each pool column then updates through
+            # a statically-unrolled chain of masked selects — one
+            # branchless compare+select per emit row, fused by XLA into
+            # a single vector pass per column. No scatters (XLA CPU
+            # lowers batched scatter to a serial per-row loop) and no
+            # gathers (a batched gather is nearly as serial — the
+            # gather-based first cut of this path measured 2.7 µs per
+            # seed-step in ONE fusion, half the whole step wall,
+            # PROFILE_CPU_r06): not-yet-due rows stream through the
+            # selects untouched.
+            free_rank = jnp.cumsum((~ev_valid_mid).astype(jnp.int32)) - 1
+            n_free = free_rank[-1] + 1
+            n_valid_em = jnp.sum(e_valid.astype(jnp.int32))
+            dropped = e_valid & (pos >= n_free)
+            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32) + n_delay_over
+            place_free = ~ev_valid_mid
+            take = place_free & (free_rank < n_valid_em)
+            # Materialize the emit rows ONCE before the per-slot select
+            # chains (see _materialize: XLA would otherwise recompute
+            # the branch select per pool slot). Identity on values.
+            e_time, e_meta, e_epoch, em_args_m, em_pay_m = _materialize(
+                (e_time, e_meta, e_epoch, em.args, em.pay)
+            )
+            # slot e takes emit j iff j is valid and e is the free slot
+            # whose rank equals j's emit rank — at most one j matches
+            sel_rows = [
+                place_free & e_valid[j] & (free_rank == pos[j])
+                for j in range(k1)
+            ]
+
+            def rplace(vals, keep):
+                """Each ready slot takes its rank-matched emit's value."""
+                extra = vals.ndim - 1
+                acc = keep
+                for j in range(k1):
+                    s = sel_rows[j].reshape((-1,) + (1,) * extra)
+                    acc = jnp.where(s, vals[j], acc)
+                return acc.astype(keep.dtype)
+
+            ev_valid = ev_valid_mid | take
+            ev_time = rplace(e_time, ev_time_mid)
+            ev_meta = rplace(e_meta, ev_meta_mid)
+            ev_epoch = rplace(e_epoch, st.ev_epoch)
+            ev_args = rplace(em_args_m, st.ev_args)
+            ev_pay = rplace(em_pay_m, st.ev_pay)
+            if timeline_cap:
+                # all emit rows share this dispatch's clock (the rule
+                # in the dense branch above) — a plain masked select
+                ev_emit = jnp.where(take, now, st.ev_emit)
+            else:
+                ev_emit = st.ev_emit
         else:
             free = jnp.flatnonzero(~ev_valid_mid, size=k1, fill_value=e_slots)
             slot = jnp.where(
@@ -2263,6 +2452,24 @@ def make_step(
                 hist_word = jnp.where(hany[:, None], picked, st.hist_word)
                 picked_t = jnp.sum(jnp.where(hmatch, rec_t[None], 0), axis=1)
                 hist_t = jnp.where(hany, picked_t, st.hist_t)
+            elif rank_place:
+                # rank-matched cold-bank append: slot hist_count + r
+                # takes the r-th KEPT record (drops are a suffix —
+                # rpos is nondecreasing, so `fits` is a prefix
+                # property and kept ranks stay contiguous). Same
+                # unrolled select-chain form as the pool placement —
+                # no scatter, no gather.
+                n_keep = jnp.sum(keep).astype(jnp.int32)
+                rel = jnp.cumsum(r_valid.astype(jnp.int32)) - 1
+                hranks = jnp.arange(hcap, dtype=jnp.int32) - st.hist_count
+                hist_word = st.hist_word
+                for j in range(rr):
+                    sel_h = keep[j] & (hranks == rel[j])
+                    hist_word = jnp.where(
+                        sel_h[:, None], rec_row[j], hist_word
+                    )
+                take_h = (hranks >= 0) & (hranks < n_keep)
+                hist_t = jnp.where(take_h, now, st.hist_t)
             else:
                 hslot = jnp.where(keep, rpos, jnp.int32(hcap))
                 hist_word = st.hist_word.at[hslot].set(rec_row, mode="drop")
@@ -2487,7 +2694,7 @@ def make_step(
             # bucket) features computed in the latency block above
             for f_lat, on_lat in lat_feats:
                 cov, cov_hits = _tap(cov, cov_hits, f_lat, on_lat)
-            if dense:
+            if dense or rank_place:
                 cov_last = jnp.where(
                     dst_oh & user_dispatch, kind, st.cov_last
                 ).astype(jnp.int32)
@@ -2663,6 +2870,7 @@ def make_run(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    placement: str | None = None,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -2680,7 +2888,7 @@ def make_run(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount, latency,
+        metrics, timeline_cap, cov_hitcount, latency, placement,
     ))
 
     def run(state: SimState) -> SimState:
@@ -2705,6 +2913,7 @@ def make_run_while(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    placement: str | None = None,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -2722,7 +2931,7 @@ def make_run_while(
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
-        metrics, timeline_cap, cov_hitcount, latency,
+        metrics, timeline_cap, cov_hitcount, latency, placement,
     ))
 
     def run(state: SimState) -> SimState:

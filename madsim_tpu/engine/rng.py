@@ -209,24 +209,38 @@ class Draw:
     and therefore vmap cleanly over the seed axis.
     """
 
-    __slots__ = ("k0", "k1", "step")
+    __slots__ = ("k0", "k1", "step", "cache")
 
     def __init__(self, seed_u64, step_u32):
         seed = jnp.asarray(seed_u64, jnp.uint64)
         self.k0 = (seed & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
         self.k1 = (seed >> jnp.uint64(32)).astype(jnp.uint32)
         self.step = jnp.asarray(step_u32, jnp.uint32)
+        self.cache = None
 
     @classmethod
-    def from_parts(cls, k0, k1, step) -> "Draw":
+    def from_parts(cls, k0, k1, step, cache=None) -> "Draw":
         d = cls.__new__(cls)
         d.k0 = jnp.asarray(k0, jnp.uint32)
         d.k1 = jnp.asarray(k1, jnp.uint32)
         d.step = jnp.asarray(step, jnp.uint32)
+        # prefetched lanes of this step's batched block
+        # (Workload.draw_purposes; engine/core.py builds the dict):
+        # purpose -> (lane0, lane1). A trace-time dict keyed by STATIC
+        # purpose ints — a cached lane is the identical
+        # (seed, step, purpose) cipher value, just generated inside the
+        # per-dispatch block instead of by its own scalar invocation.
+        d.cache = cache
         return d
 
     def bits(self, purpose) -> jnp.ndarray:
         """32 uniform bits for ``purpose`` (uint32)."""
+        if (
+            self.cache is not None
+            and isinstance(purpose, (int, np.integer))
+            and int(purpose) in self.cache
+        ):
+            return self.cache[int(purpose)][0]
         a, _ = threefry2x32(self.k0, self.k1, self.step, jnp.uint32(purpose))
         return a
 
@@ -236,7 +250,29 @@ class Draw:
         per-emit latency and loss draws this way (latency = lane 0,
         loss = lane 1 of the PURPOSE_LATENCY+slot counter); the C++
         oracle mirrors the pairing exactly."""
+        if (
+            self.cache is not None
+            and isinstance(purpose, (int, np.integer))
+            and int(purpose) in self.cache
+        ):
+            return self.cache[int(purpose)]
         return threefry2x32(self.k0, self.k1, self.step, jnp.uint32(purpose))
+
+    def block2(self, purposes):
+        """Both lanes of MANY purposes in one batched cipher application
+        — the per-dispatch BatchRNG form (PAPERS.md): the engine
+        enumerates every purpose one event-step can draw (poll cost,
+        per-emit latency/loss, dup shadows, torn prefix) as a static
+        lane vector and generates the whole block set in one
+        varying-counter threefry pass, instead of issuing separate
+        cipher calls per use. Each lane is keyed by the identical
+        ``(seed, step, purpose)`` counter a scalar :meth:`bits2` call
+        would use, so every draw VALUE is bit-identical to the
+        per-use form — the property the trace-identity pins and the
+        C++ oracle compare rely on. Returns ``(lane0, lane1)`` arrays
+        shaped like ``purposes``."""
+        p = jnp.asarray(purposes, jnp.uint32)
+        return threefry2x32(self.k0, self.k1, self.step, p)
 
     def uniform_int(self, lo, hi, purpose):
         """Uniform int64 in [lo, hi).
@@ -278,6 +314,11 @@ class Draw:
 
     def user(self, purpose):
         """32 bits in the user purpose namespace (handlers call this)."""
+        if isinstance(purpose, (int, np.integer)):
+            # static purpose: routes through the prefetch cache
+            # (Workload.draw_purposes) when the lane was batched —
+            # identical counter, identical value
+            return self.bits(PURPOSE_USER + int(purpose))
         return self.bits(jnp.uint32(PURPOSE_USER) + jnp.uint32(purpose))
 
     def user_int(self, lo, hi, purpose):

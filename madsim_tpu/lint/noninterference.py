@@ -131,6 +131,7 @@ def check_noninterference(
     entry: str = "step",
     layout: str = "scatter",
     time32: bool = False,
+    placement: str | None = None,
     dup_rows: bool = False,
     cov_words: int = 0,
     metrics: bool = False,
@@ -152,7 +153,7 @@ def check_noninterference(
     ``SimState -> SimState`` callable.
     """
     flags = dict(
-        layout=layout, time32=time32, dup_rows=dup_rows,
+        layout=layout, time32=time32, placement=placement, dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
         cov_hitcount=cov_hitcount,
         # JSON-able form (reports serialize): the spec's defining triple
@@ -173,11 +174,15 @@ def check_noninterference(
     )
     state = init(np.zeros(max(n_seeds, 1), np.uint64))
     if entry == "step":
-        fn = make_step(wl, cfg, layout=layout, time32=time32, **obs_kw)
+        fn = make_step(
+            wl, cfg, layout=layout, time32=time32, placement=placement,
+            **obs_kw,
+        )
         template = jax.tree.map(lambda a: a[0], state)
     elif entry == "run":
         fn = make_run(
-            wl, cfg, n_steps, layout=layout, time32=time32, **obs_kw
+            wl, cfg, n_steps, layout=layout, time32=time32,
+            placement=placement, **obs_kw,
         )
         template = state
     else:
@@ -288,18 +293,26 @@ BUILD_AXES = {
     ),
 }
 
-# lowering/representation axes: (layout, time32) pairs. The scatter
-# int64 build was the historical matrix; dense and time32 produce the
-# same jaxpr SHAPES (masked selects vs gathers, int32 vs int64 pool
-# times) but different equation graphs — the proof must hold over all
-# of them, and the COMBINED (dense, time32) pair is the exact program
-# an accelerator runs (layout and representation both auto-resolve
-# that way off-CPU), so it is swept too, not merely each axis alone.
+# lowering/representation axes: (layout, time32, placement) triples.
+# The scatter int64 build was the historical matrix; dense and time32
+# produce the same jaxpr SHAPES (masked selects vs gathers, int32 vs
+# int64 pool times) but different equation graphs — the proof must
+# hold over all of them, and the COMBINED (dense, time32) pair is the
+# exact program an accelerator runs (layout and representation both
+# auto-resolve that way off-CPU), so it is swept too, not merely each
+# axis alone. The placement member sweeps the scatter layout's two
+# pool-write lowerings (PR 8): "rank" is the select-chain program a
+# small-pool CPU run compiles (cold-bank appends — history rank-append,
+# timeline/latency rows — ride this path), "scatter" the historical
+# .at[].set stores a client-army-scale pool still uses; both must keep
+# the derived columns isolated, not just the default one. Dense
+# ignores placement (its one-hot writes are already rank-matched).
 LAYOUT_AXES = (
-    ("scatter", False),
-    ("dense", False),
-    ("scatter", True),
-    ("dense", True),
+    ("scatter", False, "rank"),
+    ("scatter", False, "scatter"),
+    ("dense", False, None),
+    ("scatter", True, "rank"),
+    ("dense", True, None),
 )
 
 def model_matrix() -> list:
@@ -334,10 +347,12 @@ def check_matrix(
 
     Defaults to the full certified matrix (tools/lint_soak.py scale);
     tests pass a slice for the tier-1 smoke. ``layouts`` sweeps
-    (layout, time32) lowering pairs per cell (``LAYOUT_AXES`` is the
-    full set); the single ``layout`` argument remains the one-lowering
-    form. A model whose (workload, config) is not time32-eligible is
-    skipped for time32 pairs rather than failing the matrix.
+    (layout, time32[, placement]) lowering tuples per cell
+    (``LAYOUT_AXES`` is the full set; two-tuples mean the auto
+    placement); the single ``layout`` argument remains the
+    one-lowering form. A model whose (workload, config) is not
+    time32-eligible is skipped for time32 pairs rather than failing
+    the matrix.
     """
     from ..engine.core import time32_eligible
 
@@ -350,12 +365,14 @@ def check_matrix(
         layouts = ((layout, False),)
     reports = []
     for name, wl, cfg in (models if models is not None else model_matrix()):
-        for lay, t32 in layouts:
+        for lay, t32, *rest in layouts:
+            place = rest[0] if rest else None
             if t32 and not time32_eligible(wl, cfg):
                 continue
             for axis, flags in (axes or BUILD_AXES).items():
                 rep = check_noninterference(
-                    wl, cfg, entry=entry, layout=lay, time32=t32, **flags
+                    wl, cfg, entry=entry, layout=lay, time32=t32,
+                    placement=place, **flags,
                 )
                 rep.flags["axis"] = axis
                 if log is not None:

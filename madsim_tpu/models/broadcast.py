@@ -123,4 +123,11 @@ def make_broadcast(
         delay_bound_ns=max(retx_ns, 500_000_000),
         # handlers read args[0:2] (seq / clog pair)
         args_words=2,
+        # prefetch the chaos draws into the step's batched RNG block
+        # (engine BatchRNG — see models/raftlog.py for the rule)
+        draw_purposes=(
+            (_P_CHAOS_LINK, _P_CHAOS_LINK + 16, _P_CHAOS_AT, _P_CHAOS_LEN)
+            if partition
+            else ()
+        ),
     )

@@ -434,6 +434,10 @@ def make_kvchaos(
         history=hist,
         # army mode: at most one lat_start OR lat_end per invocation
         lat_markers=1 if army else 0,
+        # prefetch handler draws into the step's batched RNG block
+        # (engine BatchRNG — see models/raftlog.py for the rule)
+        draw_purposes=((_P_KILL_AT, _P_KILL_WHO, _P_REVIVE) if chaos else ())
+        + ((_P_VAL0, _P_VAL1) if payload else ()),
     )
 
 

@@ -57,4 +57,7 @@ def make_microbench(
         delay_bound_ns=delay_max_ns,
         # no handler reads past args[1]
         args_words=2,
+        # prefetch the tick draws into the step's batched RNG block
+        # (engine BatchRNG — see models/raftlog.py for the rule)
+        draw_purposes=(_P_DELAY, _P_VALUE),
     )

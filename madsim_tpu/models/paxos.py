@@ -329,6 +329,10 @@ def make_paxos(
         # proposer incarnation; 32 covers deep re-proposal chains, and
         # overflow is loud (hist_drop) + quarantined by search_seeds
         history=HistorySpec(capacity=32, max_records=1) if record else None,
+        # prefetch handler draws into the step's batched RNG block
+        # (engine BatchRNG — see models/raftlog.py for the rule)
+        draw_purposes=(_P_START, _P_TIMEOUT)
+        + ((_P_KILL_AT, _P_KILL_WHO, _P_REVIVE) if chaos else ()),
     )
 
 

@@ -164,6 +164,9 @@ def make_raft(
         # the run halts at the first win, so concurrent in-flight wins
         # bound recorded events at a handful; 8 slots is generous
         history=HistorySpec(capacity=8, max_records=1) if record else None,
+        # prefetch the timeout draw into the step's batched RNG block
+        # (engine BatchRNG — see models/raftlog.py for the rule)
+        draw_purposes=(_P_TIMEOUT,),
     )
 
 

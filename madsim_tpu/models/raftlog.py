@@ -199,13 +199,19 @@ def make_raftlog(
     # stay byte-identical to the pre-storage model
     rec_store = record and durable
 
+    jv = jnp.arange(w, dtype=jnp.int32)  # log column index vector
+
     def _lastterm(st):
-        """Term of the last log entry (0 for an empty log)."""
+        """Term of the last log entry (0 for an empty log).
+
+        One vectorized select over the log slice — bit-identical to the
+        per-column where-chain it replaces (ll matches at most one
+        column; ll == 0 sums nothing), at 1/w the op count: the
+        lax.switch runs EVERY branch per dispatch, so per-branch op
+        count is a first-order step cost (PROFILE_CPU_r06)."""
         ll = st[LOGLEN]
-        acc = jnp.int32(0)
-        for j in range(w):
-            acc = jnp.where(ll == j + 1, _entry_term(st[LOG0 + j]), acc)
-        return acc
+        terms = _entry_term(st[LOG0 : LOG0 + w])
+        return jnp.sum(jnp.where(jv + 1 == ll, terms, 0)).astype(jnp.int32)
 
     def _arm_election(ctx, eb, new_seq, when):
         d = ctx.draw.user_int(timeout_min_ns, timeout_max_ns, _P_TIMEOUT)
@@ -348,13 +354,12 @@ def make_raftlog(
         new = st.at[VOTES].set(votes)
         new = jnp.where(wins, new.at[ROLE].set(LEADER), new)
         # win-time re-stamp: uncommitted suffix takes the new term (the
-        # figure-8 guard, see module docstring)
-        for j in range(w):
-            stamped = (new[LOG0 + j] & jnp.int32(0xFF)) | (term << jnp.int32(8))
-            restamp = wins & (jnp.int32(j) >= new[COMMIT]) & (
-                jnp.int32(j) < new[LOGLEN]
-            )
-            new = jnp.where(restamp, new.at[LOG0 + j].set(stamped), new)
+        # figure-8 guard, see module docstring) — one select over the
+        # log slice (the _lastterm vectorization rule)
+        log = new[LOG0 : LOG0 + w]
+        stamped = (log & jnp.int32(0xFF)) | (term << jnp.int32(8))
+        restamp = wins & (jv >= new[COMMIT]) & (jv < new[LOGLEN])
+        new = new.at[LOG0 : LOG0 + w].set(jnp.where(restamp, stamped, log))
         has_inflight = new[LOGLEN] > new[COMMIT]
         new = jnp.where(
             wins,
@@ -394,9 +399,10 @@ def make_raftlog(
         # higher idx. A higher term overwrites unconditionally (the new
         # leader's log is authoritative).
         adopt = ok & (idx >= 0) & (newer_term | (idx + 1 >= st[LOGLEN]))
-        for j in range(w):
-            take = adopt & (jnp.int32(j) <= idx)
-            new = jnp.where(take, new.at[LOG0 + j].set(ctx.payload[j]), new)
+        take = adopt & (jv <= idx)
+        new = new.at[LOG0 : LOG0 + w].set(
+            jnp.where(take, ctx.payload[:w], new[LOG0 : LOG0 + w])
+        )
         new = jnp.where(adopt, new.at[LOGLEN].set(idx + 1), new)
         new = jnp.where(
             ok, new.at[COMMIT].set(jnp.maximum(new[COMMIT], l_commit)), new
@@ -446,9 +452,9 @@ def make_raftlog(
             & (st[COMMIT] < st[LOGLEN])
         )
         acks = jnp.where(counts, st[ACKS] | (jnp.int32(1) << frm), st[ACKS])
-        n_acks = jnp.int32(0)
-        for p in nodes:
-            n_acks = n_acks + ((acks >> jnp.int32(p)) & jnp.int32(1))
+        n_acks = jnp.sum(
+            (acks >> jnp.arange(n_nodes, dtype=jnp.int32)) & jnp.int32(1)
+        ).astype(jnp.int32)
         commit_now = counts & (n_acks >= jnp.int32(majority))
         new = st.at[ACKS].set(acks)
         new = jnp.where(commit_now, new.at[COMMIT].set(idx + 1), new)
@@ -483,11 +489,10 @@ def make_raftlog(
         ) & ~_eio(ctx)
         value = (ctx.draw.user(_P_VALUE) & jnp.uint32(0xFF)).astype(jnp.int32)
         entry = value | (st[TERM] << jnp.int32(8))
-        new = st
-        for j in range(w):
-            new = jnp.where(
-                can & (st[LOGLEN] == j), new.at[LOG0 + j].set(entry), new
-            )
+        ins = can & (jv == st[LOGLEN])
+        new = st.at[LOG0 : LOG0 + w].set(
+            jnp.where(ins, entry, st[LOG0 : LOG0 + w])
+        )
         new = jnp.where(
             can,
             new.at[LOGLEN].set(st[LOGLEN] + 1)
@@ -601,6 +606,11 @@ def make_raftlog(
         ),
         # army mode: at most one lat_start OR lat_end per invocation
         lat_markers=1 if army else 0,
+        # prefetch every handler draw into the step's batched RNG block
+        # (engine BatchRNG): the switch runs all branches per dispatch,
+        # so each of these would otherwise be its own per-step cipher
+        draw_purposes=(_P_TIMEOUT, _P_VALUE)
+        + ((_P_KILL_AT, _P_KILL_WHO, _P_REVIVE) if chaos else ()),
     )
 
 
@@ -634,10 +644,14 @@ def lint_entries():
     axis: the storage columns become core there (a crash reads the
     disk image back into node_state) and ``engine.derived_fields``
     reclassifies them — the proof then covers the remaining derived
-    set."""
+    set. The army variant is the client-load axis: its pre-seeded pool
+    rows and lat_* marker writes ride the rank-placement select chains
+    and the cold-bank appends (PR 8), so the proof covers the engine's
+    heaviest placement surface, not just protocol traffic."""
     kw = dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
     return [
         ("raftlog/plain", make_raftlog(), kw),
         ("raftlog/record", make_raftlog(record=True), kw),
         ("raftlog/durable", make_raftlog(durable=True, record=True), kw),
+        ("raftlog/army", make_raftlog(army=True), kw),
     ]

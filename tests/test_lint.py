@@ -221,14 +221,40 @@ class TestNonInterference:
             assert "lat_hist" in rep.out_taint
             rep.to_json()  # LatencySpec flags stay JSON-able
 
+    def test_cold_bank_isolated_under_rank_placement(self):
+        """The PR-8 placement axis: the cold-bank columns (history,
+        timeline, coverage, latency) prove derived-state-isolated in
+        BOTH scatter-layout pool-write lowerings — the rank
+        select-chain program (the new small-pool CPU default, whose
+        select chains the cold-bank appends ride) and the historical
+        scatter stores. The full sweep is the slow matrix."""
+        from madsim_tpu.engine import LatencySpec
+        from madsim_tpu.models.raftlog import make_raftlog
+
+        wl = make_raftlog(army=True)
+        spec = LatencySpec(ops=8, phases=2)
+        for place in ("rank", "scatter"):
+            rep = check_noninterference(
+                wl, CFG, layout="scatter", placement=place, latency=spec,
+                timeline_cap=8, cov_words=8, metrics=True,
+            )
+            assert rep.ok, rep.summary()
+            assert rep.flags["placement"] == place
+            for col in ("hist_word", "tl_t", "cov", "lat_hist", "met"):
+                assert col in rep.out_taint, (place, col)
+
     def test_layout_axes_sweep_and_time32_skip(self):
         from madsim_tpu.lint import check_matrix
         from madsim_tpu.lint.noninterference import LAYOUT_AXES
 
-        assert ("dense", False) in LAYOUT_AXES
-        assert ("scatter", True) in LAYOUT_AXES
+        assert ("dense", False, None) in LAYOUT_AXES
+        assert ("scatter", True, "rank") in LAYOUT_AXES
         # the combined pair is the exact program an accelerator runs
-        assert ("dense", True) in LAYOUT_AXES
+        assert ("dense", True, None) in LAYOUT_AXES
+        # BOTH scatter-layout pool-write lowerings (PR 8): the rank
+        # select-chain program and the historical .at[].set stores
+        assert ("scatter", False, "rank") in LAYOUT_AXES
+        assert ("scatter", False, "scatter") in LAYOUT_AXES
         # a non-eligible (workload, config) is skipped for time32
         # pairs instead of failing the matrix
         wl = make_raft()

@@ -1,0 +1,88 @@
+"""Trace-identity pins for the PR-8 step refactor (tools/step_goldens.py).
+
+The refactored step (rank-matched placement, per-dispatch batched RNG,
+cold-bank appends) promises bit-identical VALUES to the pre-refactor
+engine. These tests recompute full-SimState digests of the recorded
+models — metrics + timeline + coverage/hit-counts + latency on, army
+plans where the model has a client surface — and compare them to
+digests captured from the PR-7-tip engine. Any value drift in the step
+function fails here with the scenario name, before it can reach a
+soak or an oracle run.
+
+Tier-1 keeps the two leanest high-coverage pins (raftlog's army
+scenario — chaos kinds + client rows + every observability column —
+on the rank-placement scatter layout and on dense); the forced
+scatter-store placement, the compacted runner and the full scenario
+matrix are ``slow`` — tier-1 runs ~650s of its 870s budget on a good
+box phase and this container drifts ~1.8x, so every tier-1 compile
+must earn its seat (ROADMAP budget note).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+
+import step_goldens  # noqa: E402
+
+from _step_goldens import GOLDENS  # noqa: E402
+
+
+def _check(name, layout=None, compact=False, **kw):
+    wl, cfg, plan, lat = step_goldens.scenarios()[name]
+    got = step_goldens.run_scenario(
+        name, wl, cfg, plan, lat, layout=layout, compact=compact, **kw
+    )
+    key = f"{name}/compact" if compact else name
+    assert got == GOLDENS[key], (
+        f"{key} (layout={layout}): step values drifted from the "
+        f"pre-refactor engine"
+    )
+
+
+class TestStepIdentityLean:
+    """The tier-1 pins: the heaviest surface, both layouts."""
+
+    def test_raftlog_army_scatter_rank(self):
+        _check("raftlog/army-obs", layout="scatter")
+
+    def test_raftlog_army_dense(self):
+        _check("raftlog/army-obs", layout="dense")
+
+
+@pytest.mark.slow
+class TestStepIdentityPlacements:
+    """The other two lowerings of the same scenario: the forced
+    scatter-store placement (the large-pool program) and the compacted
+    runner — redundant with the matrix below but kept addressable."""
+
+    def test_raftlog_army_scatter_store(self):
+        _check("raftlog/army-obs", layout="scatter", placement="scatter")
+
+    def test_raftlog_army_compacted(self):
+        _check("raftlog/army-obs", compact=True)
+
+
+@pytest.mark.slow
+class TestStepIdentityMatrix:
+    """Every captured scenario, every lowering (the full safety net)."""
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_scatter(self, name):
+        _check(name, layout="scatter")
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_scatter_store_placement(self, name):
+        _check(name, layout="scatter", placement="scatter")
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_dense(self, name):
+        _check(name, layout="dense")
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_compacted(self, name):
+        _check(name, compact=True)

@@ -1,0 +1,149 @@
+"""Per-config step profile: phase wall breakdown + HLO cost analysis.
+
+Replaces the hand-run PROFILE_CPU_r05 flow: for each requested bench
+config this tool measures where a fused step's wall time actually goes
+— by timing ABLATED step variants whose difference isolates one phase —
+and attaches XLA's own HLO cost analysis (flop / byte counts) for the
+compiled step, so a perf claim can be attributed to a phase instead of
+guessed. Ablated variants change values (nop handlers, shrunk pools);
+they exist only to difference wall times, never to verify anything —
+trace identity is tools/step_goldens.py's job.
+
+Rows (JSONL, one per config):
+
+    {"config": ..., "n_seeds": ..., "n_steps": ...,
+     "ns_per_seed_step": {"full": ..., "nop_handlers": ...,
+                          "placement_scatter": ..., "pool_half": ...,
+                          "emits_k1": ...},
+     "attribution": {"handlers": ..., "pool+placement (half-pool "
+                     "delta)": ..., "emit+rng lanes (k1 delta)": ...},
+     "hlo": {"flops": ..., "bytes_accessed": ..., "transcendentals": ...}}
+
+Usage:
+
+    python tools/profile_step.py [config ...] > PROFILE_CPU_rNN.jsonl
+    make profile
+
+Not part of tier-1 (pure measurement, no assertions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import _bootstrap  # noqa: F401  (sys.path for tools/)
+
+import numpy as np
+
+import jax
+from jax import lax
+
+from madsim_tpu.engine import EngineConfig, make_init
+from madsim_tpu.engine.core import make_step
+from madsim_tpu.models import BENCH_SPECS
+
+DEFAULT_CONFIGS = ("raftlog", "kvchaos", "raft")
+N_SEEDS = 4096
+N_STEPS = 200
+
+
+def _nop_handler(ctx):
+    return ctx.state, ctx.emits().build()
+
+
+def _time_variant(wl, cfg, n_seeds, n_steps, **mk) -> float:
+    """Best-of-3 wall of a jitted n_steps scan, ns per seed-step."""
+    step = jax.vmap(make_step(wl, cfg, **mk))
+
+    def run(st):
+        def body(s, _):
+            return step(s), None
+
+        final, _ = lax.scan(body, st, None, length=n_steps)
+        return final
+
+    r = jax.jit(run)
+    st = make_init(wl, cfg)(np.arange(n_seeds, dtype=np.uint64))
+    jax.block_until_ready(r(st))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
+        jax.block_until_ready(r(st))
+        best = min(best, time.perf_counter() - t0)  # lint: allow(wall-clock)
+    return best / (n_seeds * n_steps) * 1e9
+
+
+def _hlo_cost(wl, cfg) -> dict:
+    """XLA's cost analysis of ONE vmapped step (the scan body)."""
+    step = jax.vmap(make_step(wl, cfg))
+    st = make_init(wl, cfg)(np.arange(N_SEEDS, dtype=np.uint64))
+    try:
+        cost = jax.jit(step).lower(st).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return {
+            "flops": cost.get("flops"),
+            "bytes_accessed": cost.get("bytes accessed"),
+            "transcendentals": cost.get("transcendentals"),
+        }
+    except Exception as exc:  # cost analysis is best-effort per backend
+        return {"error": repr(exc)}
+
+
+def profile_config(name: str, n_seeds: int = N_SEEDS, n_steps: int = N_STEPS) -> dict:
+    factory, cfg_kwargs, _s, _n = BENCH_SPECS[name]
+    wl, cfg = factory(), EngineConfig(**cfg_kwargs)
+    wl_nop = dataclasses.replace(
+        wl, handlers=tuple(_nop_handler for _ in wl.handlers),
+        handler_names=None,
+    )
+    cfg_half = dataclasses.replace(
+        cfg, pool_size=max(wl.n_nodes + 1, cfg.pool_size // 2)
+    )
+    wl_k1 = dataclasses.replace(
+        wl_nop, max_emits=1, payload_words=0, handler_names=None
+    )
+
+    ns = {
+        "full": _time_variant(wl, cfg, n_seeds, n_steps),
+        "nop_handlers": _time_variant(wl_nop, cfg, n_seeds, n_steps),
+        "placement_scatter": _time_variant(
+            wl, cfg, n_seeds, n_steps, placement="scatter"
+        ),
+        "pool_half": _time_variant(wl_nop, cfg_half, n_seeds, n_steps),
+        "emits_k1": _time_variant(wl_k1, cfg, n_seeds, n_steps),
+    }
+    row = {
+        "config": name,
+        "platform": jax.devices()[0].platform,
+        "n_seeds": n_seeds,
+        "n_steps": n_steps,
+        "ns_per_seed_step": {k: round(v, 1) for k, v in ns.items()},
+        "attribution": {
+            "handlers": round(ns["full"] - ns["nop_handlers"], 1),
+            "pool+placement (half-pool delta)": round(
+                ns["nop_handlers"] - ns["pool_half"], 1
+            ),
+            "emit+rng lanes (k1 delta)": round(
+                ns["nop_handlers"] - ns["emits_k1"], 1
+            ),
+        },
+        "hlo": _hlo_cost(wl, cfg),
+    }
+    return row
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(DEFAULT_CONFIGS)
+    for name in names:
+        if name not in BENCH_SPECS:
+            raise SystemExit(f"unknown config {name!r} (BENCH_SPECS)")
+        row = profile_config(name)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

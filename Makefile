@@ -25,7 +25,8 @@ SHELL   := /bin/bash
 
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
-        store-soak latency-soak lint lint-soak profile clean
+        store-soak latency-soak lint lint-soak profile clean \
+        campaign-bench
 
 check: native lint test determinism bench-smoke
 	@echo "== make check: all gates passed =="
@@ -104,6 +105,19 @@ nemesis-soak:
 EXPLORE_BUDGET ?= 2048
 explore:
 	$(PY) tools/explore_soak.py $(EXPLORE_BUDGET)
+
+# Campaign driver A/B (madsim_tpu/explore/device.py): the same guided
+# campaign run alternately by the host-driven and the device-resident
+# driver, interleaved rounds — bit-identical outcomes, device >=3x
+# generations/s at CAMPAIGN_BATCH seeds/generation, exactly one
+# summary-sized host sync per generation (asserted from telemetry),
+# plus the lean guided-vs-uniform quality guard. The CAMPAIGN artifact.
+CAMPAIGN_BATCH  ?= 65536
+CAMPAIGN_GENS   ?= 5
+CAMPAIGN_ROUNDS ?= 3
+campaign-bench:
+	$(PY) tools/campaign_bench.py $(CAMPAIGN_BATCH) $(CAMPAIGN_GENS) \
+	    $(CAMPAIGN_ROUNDS)
 
 # Observability soak (madsim_tpu.obs): obs-off identity at soak scale,
 # device-reduced fleet metrics on OBS_SEEDS seeds, the raftlog

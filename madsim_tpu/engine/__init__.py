@@ -78,7 +78,7 @@ from .compact import make_run_compacted  # noqa: E402,F401
 from .verify import check_determinism, check_layouts, compare_traces  # noqa: E402,F401
 from .checkpoint import load as load_checkpoint  # noqa: E402,F401
 from .checkpoint import save as save_checkpoint  # noqa: E402,F401
-from .search import SearchReport, search_seeds  # noqa: E402,F401
+from .search import SearchReport, make_sweep, search_seeds  # noqa: E402,F401
 from .replay import ReplayEvent, format_timeline, refold, replay  # noqa: E402,F401
 from .rng import (  # noqa: E402,F401
     Draw,

@@ -45,13 +45,77 @@ from .core import (
     make_run_while,
 )
 
-__all__ = ["SearchReport", "search_seeds"]
+__all__ = ["SearchReport", "make_sweep", "search_seeds"]
 
 # compiled-run cache: repeated searches over the same (workload, config,
 # step budget, layout) — the tool's own repro workflow — reuse the XLA
 # program instead of re-tracing per call (jit's cache keys on function
 # identity, so a fresh closure per call would defeat it)
 _RUN_CACHE: dict = {}
+
+
+def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
+                    layout=None, plan_slots: int = 0, dup_rows: bool = False,
+                    cov_words: int = 0, metrics: bool = False,
+                    timeline_cap: int = 0, cov_hitcount: bool = False,
+                    latency=None, compact: bool = False):
+    # the ONE construction of a batched sweep's (init, run) pair —
+    # make_sweep (the device-composable form) and search_seeds' cached
+    # runner both build through here, so a flag added to one path cannot
+    # silently miss the other and break host/device bit-identity
+    obs_kw = dict(
+        metrics=metrics, timeline_cap=timeline_cap,
+        cov_hitcount=cov_hitcount, latency=latency,
+    )
+    init = make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
+                     **obs_kw)
+    mk = make_run_compacted if compact else make_run_while
+    run = mk(
+        wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
+        cov_words=cov_words, **obs_kw,
+    )
+    return init, run
+
+
+def make_sweep(
+    wl: Workload,
+    cfg: EngineConfig,
+    max_steps: int,
+    *,
+    layout=None,
+    plan_slots: int = 0,
+    dup_rows: bool = False,
+    cov_words: int = 0,
+    metrics: bool = False,
+    timeline_cap: int = 0,
+    cov_hitcount: bool = False,
+    latency=None,
+):
+    """Build the traceable batched sweep: ``sweep(seeds[, rows]) -> view``.
+
+    The device-resident form of one ``search_seeds`` dispatch: init the
+    seed batch (with the compiled ``PlanRows`` when ``plan_slots > 0``),
+    run ``make_run_while`` to the step cap, and return the final state
+    as a ``{field name: device array}`` view — NO host transfer, no
+    invariant evaluation, and the function is jit/shard_map-traceable,
+    so callers can fuse it into larger device programs (the explore
+    device driver composes it with on-device mutation and admission;
+    ``search_seeds`` wraps it with the host-side report instead).
+    """
+    init, run = _build_init_run(
+        wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
+        dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
+        timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+        latency=latency,
+    )
+
+    def sweep(seeds, rows=None):
+        out = run(init(seeds, rows) if plan_slots else init(seeds))
+        return {
+            f.name: getattr(out, f.name) for f in dataclasses.fields(out)
+        }
+
+    return sweep
 
 
 def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
@@ -66,24 +130,16 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
            latency)
     if key not in _RUN_CACHE:
-        obs_kw = dict(
-            metrics=metrics, timeline_cap=timeline_cap,
-            cov_hitcount=cov_hitcount, latency=latency,
+        init, run = _build_init_run(
+            wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
+            dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
+            timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
+            latency=latency, compact=compact,
         )
-        if compact:
-            run = make_run_compacted(
-                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-                cov_words=cov_words, **obs_kw,
-            )
-        else:
-            run = jax.jit(make_run_while(
-                wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-                cov_words=cov_words, **obs_kw,
-            ))
+        # make_run_compacted jits internally per growth stage
         _RUN_CACHE[key] = (
-            make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
-                      **obs_kw),
-            run,
+            init,
+            run if compact else jax.jit(run),
             wl,  # keep the workload alive so id() stays unique
         )
     return _RUN_CACHE[key]

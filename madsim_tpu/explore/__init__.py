@@ -25,13 +25,19 @@ multiplies violation counts over the uniform nemesis sweep.
 """
 
 from .coverage import admit, merge, popcount  # noqa: F401
+from .device import run_device  # noqa: F401
 from .driver import (  # noqa: F401
     CorpusEntry,
     ExploreReport,
     replay_entry,
     run,
 )
-from .mutate import HostStream, PlanSpace, mutate_plan  # noqa: F401
+from .mutate import (  # noqa: F401
+    HostStream,
+    PlanSpace,
+    mutate_plan,
+    mutation_table,
+)
 from .persist import (  # noqa: F401
     CampaignState,
     load_campaign,
@@ -48,8 +54,10 @@ __all__ = [
     "load_campaign",
     "merge",
     "mutate_plan",
+    "mutation_table",
     "popcount",
     "replay_entry",
     "run",
+    "run_device",
     "save_campaign",
 ]

@@ -1,0 +1,926 @@
+"""Device-resident exploration campaigns — one host sync per generation.
+
+The host driver (explore/driver.py) round-trips through numpy every
+generation: corpus selection, mutation and admission all run on the
+host while the accelerator idles, and the whole per-seed result state
+crosses the PCIe boundary each dispatch. This module is the same
+campaign loop restated as a device program:
+
+* the **corpus lives in device memory** as fixed-capacity column arrays
+  (plan rows, seeds, traces, coverage signatures, ids — one row per
+  admitted entry);
+* **mutation** is a vectorized jnp kernel (:func:`_mutate_child` under
+  ``vmap``) that emulates the host edit script *draw for draw*: the
+  same threefry counters, the same modulo reductions, the same
+  branch structure as ``HostStream`` + ``mutate_plan`` — so a device
+  campaign breeds bit-identical children (the parity test pins it);
+* **admission** is one ``lax.scan`` over the generation in batch order
+  (popcount-delta against the global map + the (seed, trace) violation
+  dedup), with the winners scattered into the corpus arrays;
+* the whole generation — derive keys, pick parents, mutate, simulate
+  (``engine.make_sweep``), admit — is ONE jitted program per mode
+  (uniform / breeding). With a ``mesh``, mutation and simulation run
+  under ``shard_map`` across chips (corpus replicated, the (seed, plan)
+  batch sharded — the multi-process pjit shape); the cross-shard
+  metric/latency folds reuse ``parallel.merge_metrics`` /
+  ``merge_latency``, and the admission scan consumes the gathered
+  per-seed coverage rows without ever leaving the device.
+
+The host sees exactly one synchronization point per generation: the
+admission summary (corpus size, new-entry count, coverage bits,
+violation count) and — when logging asks for them — the fresh
+violation keys. Per-seed state never reaches the host until the final
+report (or a checkpoint) materializes the corpus once.
+
+Campaign outcomes are **bit-identical to the host driver** given the
+same arguments: same corpus (ids, seeds, plans, traces, new-bit
+scores), same coverage map, same violations, same replay keys — the
+device path is a lowering, not a fork. ``checkpoint_path`` / ``resume``
+interoperate with host-driver checkpoints in both directions.
+
+Limitations vs the host driver: the invariant must be a *traceable*
+final-state predicate (jnp ops over the state view — it runs inside
+the device program; numpy-only predicates and ``history_invariant``
+checkers need the host driver), and ``compact=True`` has no device
+equivalent (the sweep runs ``make_run_while``).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P_
+
+from ..chaos.plan import FaultEvent, FaultPlan, LiteralPlan, stack_plan_rows
+from ..engine.core import PlanRows, _resolve_time32
+from ..engine.rng import PURPOSE_EXPLORE, threefry2x32
+from ..engine.search import make_sweep
+from .driver import CorpusEntry, ExploreReport, _pad_literal
+from .mutate import (
+    MODE_NODE,
+    MODE_PAIR,
+    MODE_RETIME,
+    MODE_SKEW,
+    MODE_SLOW,
+    PlanSpace,
+    inherit_threshold,
+    mutation_table,
+)
+
+__all__ = ["run_device"]
+
+
+def _kth_true(mask, k):
+    """Index of the (k+1)-th True of ``mask`` — the device form of the
+    host's ``index_list[k]`` pick (callers guarantee k < popcount)."""
+    cum = jnp.cumsum(mask.astype(jnp.int32))
+    return jnp.argmax(mask & (cum == k + 1)).astype(jnp.int32)
+
+
+def _mk_seeds(k0s, k1s):
+    return k0s.astype(jnp.uint64) | (k1s.astype(jnp.uint64) << jnp.uint64(32))
+
+
+# ---------------------------------------------------------------------------
+# the vectorized mutator — HostStream + mutate_plan, draw for draw
+# ---------------------------------------------------------------------------
+
+
+def _make_child_mutator(tb, max_ops: int, inherit_thresh: int):
+    """Build ``child(k0, k1, fresh_seed, order, olen, cr) -> dict`` —
+    the device form of one batch slot's host edit script:
+
+        st = HostStream(k0, k1, PURPOSE_EXPLORE)
+        pid = order[st.bits() % len(order)]          # draw 0
+        inherit = st.bits() < inherit_thresh          # draw 1
+        child = mutate_plan(parent, space, st, ...)   # draws 2..
+
+    Every draw is ``threefry2x32(k0, k1, j, PURPOSE_EXPLORE)[0]`` at
+    the same running counter ``j`` the HostStream would use; branches
+    advance ``j`` by exactly the number of draws the host branch
+    consumes (``mutate.RETARGET_DRAWS``), so the two edit scripts stay
+    aligned no matter which ops fire.
+    """
+    X1 = jnp.uint32(PURPOSE_EXPLORE)
+    t_lo, t_hi = tb["t_lo"], tb["t_hi"]
+    mode, rt_d = tb["mode"], tb["rt_draws"]
+    tgt, tcnt = tb["tgt"], tb["tcnt"]
+    mult_lo, mult_hi = tb["mult_lo"], tb["mult_hi"]
+    skew_lo, skew_hi = tb["skew_lo"], tb["skew_hi"]
+    p_slots = int(t_lo.shape[0])
+
+    def bits(k0, k1, j):
+        a, _ = threefry2x32(k0, k1, j, X1)
+        return a
+
+    def child(k0, k1, fresh_seed, order, olen, cr):
+        w0 = bits(k0, k1, jnp.uint32(0)).astype(jnp.int64)
+        pslot = order[(w0 % olen).astype(jnp.int32)]
+        w1 = bits(k0, k1, jnp.uint32(1)).astype(jnp.int64)
+        inherit = w1 < jnp.int64(inherit_thresh)
+        seed = jnp.where(inherit, cr["cs"][pslot], fresh_seed)
+        halt = cr["chalt"][pslot]
+        has_h = halt > 0
+        w2 = bits(k0, k1, jnp.uint32(2)).astype(jnp.int64)
+        n_ops = 1 + (w2 % max(max_ops, 1))
+
+        def retime(sel, told, cw, vw):
+            lo = t_lo[sel]
+            hi0 = t_hi[sel]
+            # the parent's causal window: an event past the halt clock
+            # can never change the trajectory (mutate._retime)
+            hi = jnp.where(has_h & (lo < halt) & (halt < hi0), halt, hi0)
+            delta = jnp.maximum((hi - lo) // 8, 1)
+            tf = jnp.clip(told + (-delta + vw % (2 * delta + 1)), lo, hi - 1)
+            tc = lo + vw % jnp.maximum(hi - lo, 1)
+            return jnp.where((cw % 2) == 0, tf, tc)
+
+        def pick_tgt(sel, w):
+            k = (w % jnp.maximum(tcnt[sel].astype(jnp.int64), 1)).astype(
+                jnp.int32
+            )
+            return tgt[sel, k]
+
+        def pick_tgt_ne(sel, a, w):
+            # the host's [t for t in targets if t != a] pick: exclusion
+            # is by VALUE, order preserved
+            row = tgt[sel]
+            ok = (jnp.arange(row.shape[0]) < tcnt[sel]) & (row != a)
+            cnt = ok.sum().astype(jnp.int64)
+            m = (w % jnp.maximum(cnt, 1)).astype(jnp.int32)
+            return row[_kth_true(ok, m)]
+
+        def body(it, carry):
+            j, t, a0, a1, en = carry
+            active = it < n_ops
+            wlane, _ = threefry2x32(
+                k0, k1, j + jnp.arange(7, dtype=jnp.uint32), X1
+            )
+            w = wlane.astype(jnp.int64)
+            op = w[0] % 8
+            n_on = en.sum().astype(jnp.int64)
+            n_off = p_slots - n_on
+            alive = en & (t < halt)
+            n_alive = alive.sum().astype(jnp.int64)
+            use_alive = has_h & (n_alive > 0)
+            sel_mask = jnp.where(use_alive, alive, en)
+            sel_cnt = jnp.where(use_alive, n_alive, n_on)
+            # mutate_plan's if/elif chain, one branch per op
+            b_add = (op == 0) & (n_off > 0)
+            b_drop = (op == 1) & (n_on > 1)
+            b_ret = ((op == 2) | (op == 3)) & (n_on > 0)
+            b_time = ~(b_add | b_drop | b_ret) & (n_on > 0)
+            b_fadd = ~(b_add | b_drop | b_ret | b_time) & (n_off > 0)
+            any_add = b_add | b_fadd
+            k_off = (w[1] % jnp.maximum(n_off, 1)).astype(jnp.int32)
+            k_on = (w[1] % jnp.maximum(sel_cnt, 1)).astype(jnp.int32)
+            sel = jnp.where(any_add, _kth_true(~en, k_off),
+                            _kth_true(sel_mask, k_on))
+            m = mode[sel]
+            rd = rt_d[sel].astype(jnp.int64)
+            is_fb = m == MODE_RETIME
+            t_sel = t[sel]
+            # add/force-add and plain-retime both draw (choose, value)
+            # at w[2], w[3]; retarget draws start at w[4] after an add's
+            # retime, at w[2] otherwise
+            t_rt1 = retime(sel, t_sel, w[2], w[3])
+            rw0 = jnp.where(any_add, w[4], w[2])
+            rw1 = jnp.where(any_add, w[5], w[3])
+            rw2 = jnp.where(any_add, w[6], w[4])
+            # fallback retarget = a second retime (reading the time the
+            # add's first retime just wrote, exactly like the host's
+            # in-place event list)
+            t_fb = retime(sel, jnp.where(any_add, t_rt1, t_sel), rw0, rw1)
+            aa = pick_tgt(sel, rw0)
+            bb = pick_tgt_ne(sel, aa, rw1)
+            mult = mult_lo[sel] + rw2 % jnp.maximum(
+                mult_hi[sel] + 1 - mult_lo[sel], 1
+            )
+            slow_a1 = ((bb + 1) & 0xFF) | (mult << 8)
+            skew = skew_lo[sel] + rw1 % jnp.maximum(
+                skew_hi[sel] + 1 - skew_lo[sel], 1
+            )
+            a0_sel = a0[sel].astype(jnp.int64)
+            a1_sel = a1[sel].astype(jnp.int64)
+            new_a0 = jnp.select(
+                [m == MODE_NODE, m == MODE_PAIR, m == MODE_SLOW,
+                 m == MODE_SKEW],
+                [aa, aa, aa, aa], a0_sel,
+            )
+            new_a1 = jnp.select(
+                [m == MODE_NODE, m == MODE_PAIR, m == MODE_SLOW,
+                 m == MODE_SKEW],
+                [a1_sel, bb, slow_a1, skew], a1_sel,
+            )
+            t_add = jnp.where(is_fb, t_fb, t_rt1)
+            t_ret = jnp.where(is_fb, t_fb, t_sel)
+            new_t = jnp.where(
+                any_add, t_add,
+                jnp.where(b_ret, t_ret,
+                          jnp.where(b_time, t_rt1, t_sel)),
+            )
+            write_t = active & (any_add | b_ret | b_time)
+            write_a = active & (any_add | b_ret)
+            t2 = t.at[sel].set(jnp.where(write_t, new_t, t_sel))
+            a02 = a0.at[sel].set(
+                jnp.where(write_a, new_a0, a0_sel).astype(jnp.int32)
+            )
+            a12 = a1.at[sel].set(
+                jnp.where(write_a, new_a1, a1_sel).astype(jnp.int32)
+            )
+            en2 = en.at[sel].set(
+                jnp.where(active & any_add, True,
+                          jnp.where(active & b_drop, False, en[sel]))
+            )
+            cost = jnp.where(
+                any_add, 4 + rd,
+                jnp.where(b_drop, 2,
+                          jnp.where(b_ret, 2 + rd,
+                                    jnp.where(b_time, 4, 0))),
+            )
+            j2 = j + jnp.where(active, cost, 0).astype(jnp.uint32)
+            return j2, t2, a02, a12, en2
+
+        t0 = cr["ct"][pslot]
+        a0_0 = cr["ca"][pslot, :, 0]
+        a1_0 = cr["ca"][pslot, :, 1]
+        en0 = cr["cv"][pslot]
+        _, t, a0, a1, en = lax.fori_loop(
+            0, max(max_ops, 1), body, (jnp.uint32(3), t0, a0_0, a1_0, en0)
+        )
+        return dict(
+            seed=seed,
+            time=t,
+            kind=cr["ck"][pslot],
+            args=jnp.stack([a0, a1], axis=-1),
+            valid=en,
+            node=cr["cn"][pslot],
+            parent=cr["cid"][pslot],
+        )
+
+    return child
+
+
+# ---------------------------------------------------------------------------
+# carry <-> host state
+# ---------------------------------------------------------------------------
+
+_ROW_KEYS = ("time", "kind", "args", "valid", "node")
+
+
+def _empty_store(cap1, p, cw):
+    """One entry store (corpus or violation) of ``cap1`` rows — the
+    last row is scatter trash for refused candidates, never read."""
+    return dict(
+        time=jnp.zeros((cap1, p), jnp.int64),
+        kind=jnp.zeros((cap1, p), jnp.int32),
+        args=jnp.zeros((cap1, p, 2), jnp.int32),
+        valid=jnp.zeros((cap1, p), jnp.bool_),
+        node=jnp.zeros((cap1, p), jnp.int32),
+        seed=jnp.zeros((cap1,), jnp.uint64),
+        trace=jnp.zeros((cap1,), jnp.uint64),
+        cov=jnp.zeros((cap1, cw), jnp.uint32),
+        new_bits=jnp.zeros((cap1,), jnp.int32),
+        id=jnp.full((cap1,), -1, jnp.int32),
+        parent=jnp.full((cap1,), -1, jnp.int32),
+        gen=jnp.zeros((cap1,), jnp.int32),
+        viol=jnp.zeros((cap1,), jnp.bool_),
+        halt=jnp.zeros((cap1,), jnp.int64),
+        bslot=jnp.full((cap1,), -1, jnp.int32),
+    )
+
+
+def _fill_store(store, entries):
+    """Load checkpointed CorpusEntry rows into a device store (slot i =
+    entries[i], admission order — ids stay whatever the campaign
+    assigned)."""
+    if not entries:
+        return store
+    rows = stack_plan_rows([e.plan for e in entries])
+    n = len(entries)
+    out = dict(store)
+    out["time"] = store["time"].at[:n].set(jnp.asarray(rows.time, jnp.int64))
+    out["kind"] = store["kind"].at[:n].set(jnp.asarray(rows.kind, jnp.int32))
+    out["args"] = store["args"].at[:n].set(jnp.asarray(rows.args, jnp.int32))
+    out["valid"] = store["valid"].at[:n].set(
+        jnp.asarray(rows.valid, jnp.bool_)
+    )
+    out["node"] = store["node"].at[:n].set(jnp.asarray(rows.node, jnp.int32))
+    out["seed"] = store["seed"].at[:n].set(
+        jnp.asarray([e.seed for e in entries], jnp.uint64)
+    )
+    out["trace"] = store["trace"].at[:n].set(
+        jnp.asarray([e.trace for e in entries], jnp.uint64)
+    )
+    out["cov"] = store["cov"].at[:n].set(
+        jnp.asarray(np.stack([np.asarray(e.cov, np.uint32) for e in entries]))
+    )
+    out["new_bits"] = store["new_bits"].at[:n].set(
+        jnp.asarray([e.new_bits for e in entries], jnp.int32)
+    )
+    out["id"] = store["id"].at[:n].set(
+        jnp.asarray([e.id for e in entries], jnp.int32)
+    )
+    out["parent"] = store["parent"].at[:n].set(
+        jnp.asarray([e.parent for e in entries], jnp.int32)
+    )
+    out["gen"] = store["gen"].at[:n].set(
+        jnp.asarray([e.generation for e in entries], jnp.int32)
+    )
+    out["viol"] = store["viol"].at[:n].set(
+        jnp.asarray([e.violating for e in entries], jnp.bool_)
+    )
+    out["halt"] = store["halt"].at[:n].set(
+        jnp.asarray([e.halt_t for e in entries], jnp.int64)
+    )
+    return out
+
+
+def _store_entry(st_np, i, name) -> CorpusEntry:
+    """Materialize store row ``i`` back into a CorpusEntry."""
+    events = tuple(
+        FaultEvent(
+            t=int(st_np["time"][i, p]),
+            kind=int(st_np["kind"][i, p]),
+            a0=int(st_np["args"][i, p, 0]),
+            a1=int(st_np["args"][i, p, 1]),
+            node=int(st_np["node"][i, p]),
+        )
+        for p in range(st_np["time"].shape[1])
+    )
+    return CorpusEntry(
+        id=int(st_np["id"][i]),
+        generation=int(st_np["gen"][i]),
+        parent=int(st_np["parent"][i]),
+        seed=int(st_np["seed"][i]),
+        plan=LiteralPlan(
+            events=events,
+            enabled=tuple(bool(x) for x in st_np["valid"][i]),
+            name=name,
+        ),
+        trace=int(st_np["trace"][i]),
+        cov=np.asarray(st_np["cov"][i], np.uint32).copy(),
+        new_bits=int(st_np["new_bits"][i]),
+        violating=bool(st_np["viol"][i]),
+        halt_t=int(st_np["halt"][i]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+
+def run_device(
+    wl,
+    cfg,
+    space,
+    *,
+    invariant,
+    generations: int = 8,
+    batch: int = 256,
+    root_seed: int = 0,
+    max_steps: int = 1000,
+    cov_words: int = 32,
+    layout: str | None = None,
+    require_halt: bool = False,
+    seed_corpus=(),
+    select_top: int = 32,
+    max_corpus: int = 4096,
+    max_ops: int = 3,
+    inherit_seed_p: float = 0.75,
+    log=None,
+    cov_hitcount: bool = False,
+    telemetry=None,
+    resume=None,
+    checkpoint_path: str | None = None,
+    latency=None,
+    metrics: bool = False,
+    mesh=None,
+    viol_cap: int | None = None,
+) -> ExploreReport:
+    """Run one exploration campaign with every generation device-resident.
+
+    Same contract and bit-identical outcomes as :func:`explore.run`
+    (module docstring), with these differences:
+
+    * ``invariant`` is REQUIRED and must be jnp-traceable over the final
+      state view (``{field: array} -> (S,) bool``) — it runs inside the
+      device program. ``history_invariant`` hunts need the host driver.
+    * ``mesh`` (a ``parallel.make_mesh`` Mesh) shards mutation and the
+      sweep across chips with ``shard_map``; ``batch`` must divide over
+      the device count. Sharded and unsharded campaigns are identical.
+    * ``metrics=True`` folds per-generation fleet-metric totals into the
+      telemetry records (``parallel.merge_metrics`` — per-device sums,
+      device-count rows to the host); ``latency`` likewise folds fleet
+      sketches via ``parallel.merge_latency``. Both are derived state:
+      campaign outcomes are unchanged.
+    * ``viol_cap`` bounds the device violation store (default
+      ``max_corpus``); a campaign that finds more raises instead of
+      silently breaking the (seed, trace) dedup.
+    * ``checkpoint_path`` materializes the corpus to the host after
+      every generation (that is what a checkpoint IS) — set it only
+      when resumability is worth the extra transfer.
+
+    The per-generation host sync transfers only the admission summary
+    (corpus size, new entries, coverage bits, violation count) and the
+    fresh violation keys; telemetry records carry the dispatch/sync
+    wall split and ``host_syncs: 1`` so the claim is checkable from the
+    artifact.
+    """
+    if isinstance(space, FaultPlan):
+        space = PlanSpace(space)
+    if invariant is None:
+        raise ValueError(
+            "run_device needs a traceable final-state invariant (it is "
+            "evaluated inside the device program); history_invariant "
+            "checkers run host-side — use explore.run for those hunts"
+        )
+    if cov_words < 1:
+        raise ValueError("exploration needs cov_words >= 1 (the guidance)")
+    if generations < 1 or batch < 1:
+        raise ValueError("need generations >= 1 and batch >= 1")
+    if len(seed_corpus) > batch:
+        raise ValueError(
+            f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
+        )
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    if batch % n_dev:
+        raise ValueError(
+            f"batch={batch} does not split over {n_dev} mesh devices"
+        )
+    vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
+    dup = space.uses_dup()
+    p_slots = space.slots
+    cmax1 = int(max_corpus) + 1
+    vcap1 = vcap + 1
+
+    # host-side validations the host driver gets from search_seeds:
+    # plan targets/user kinds against the workload, and the time32
+    # horizon (checked statically over the template windows — mutation
+    # and compilation both stay inside them)
+    space.plan.compile_batch(np.zeros(1, np.uint64), wl=wl)
+    tb_np = mutation_table(space)
+    if _resolve_time32(wl, cfg, None):
+        from ..engine.core import _T32_LIMIT
+
+        lim = _T32_LIMIT - cfg.proc_max_ns - 1
+        worst = int(tb_np["t_hi"].max(initial=1)) - 1
+        if seed_corpus:
+            worst = max(
+                worst,
+                max(e.t for lp in seed_corpus for e in lp.events),
+            )
+        if worst > lim:
+            raise ValueError(
+                f"plan-space window reaches t={worst} ns, past the int32 "
+                f"time horizon ({lim} ns) active for this (workload, "
+                f"config); shrink the windows or disable time32"
+            )
+
+    # ---- resumed / fresh host mirrors ----
+    loaded_corpus: list = []
+    loaded_viol: list = []
+    if resume is not None:
+        from .persist import resolve_resume
+
+        st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
+                            cov_words, cov_hitcount)
+        if len(st.corpus) > max_corpus:
+            raise ValueError(
+                f"checkpoint carries {len(st.corpus)} corpus entries; "
+                f"max_corpus={max_corpus} cannot hold them"
+            )
+        if len(st.violations) > vcap:
+            raise ValueError(
+                f"checkpoint carries {len(st.violations)} violations; "
+                f"raise viol_cap (now {vcap})"
+            )
+        loaded_corpus = list(st.corpus)
+        loaded_viol = list(st.violations)
+        gmap0 = np.asarray(st.cov_map, np.uint32)
+        curve = list(st.curve)
+        viol_curve = list(st.viol_curve)
+        next_id0 = st.next_id
+        sims = st.sims
+        g_start = st.generations_done
+    else:
+        gmap0 = np.zeros((cov_words,), np.uint32)
+        curve = []
+        viol_curve = []
+        next_id0 = 0
+        sims = 0
+        g_start = 0
+
+    carry = dict(
+        c=_fill_store(_empty_store(cmax1, p_slots, cov_words), loaded_corpus),
+        v=_fill_store(_empty_store(vcap1, p_slots, cov_words), loaded_viol),
+        gmap=jnp.asarray(gmap0),
+        count=jnp.int32(len(loaded_corpus)),
+        next_id=jnp.int32(next_id0),
+        vcount=jnp.int32(len(loaded_viol)),
+        over=jnp.bool_(False),
+    )
+    count = len(loaded_corpus)  # host mirror (decides uniform vs breed)
+
+    # materialized-entry caches: slot -> CorpusEntry. Loaded entries are
+    # returned as the same objects (names and identity survive resume);
+    # new slots materialize once and are reused by every later
+    # checkpoint/report build.
+    c_cache = {i: e for i, e in enumerate(loaded_corpus)}
+    v_cache = {i: e for i, e in enumerate(loaded_viol)}
+
+    # ---- the device programs ----
+    b_loc = batch // n_dev
+    axes = mesh.axis_names if mesh is not None else None
+    rk0 = jnp.uint32(int(root_seed) & 0xFFFFFFFF)
+    rk1 = jnp.uint32((int(root_seed) >> 32) & 0xFFFFFFFF)
+    tb = {k: jnp.asarray(v) for k, v in tb_np.items()}
+    mutator = _make_child_mutator(
+        tb, max_ops, inherit_threshold(inherit_seed_p)
+    )
+    sweep = make_sweep(
+        wl, cfg, max_steps, layout=layout, plan_slots=p_slots,
+        dup_rows=dup, cov_words=cov_words, metrics=metrics,
+        timeline_cap=0, cov_hitcount=cov_hitcount, latency=latency,
+    )
+    k_ov = len(seed_corpus)
+    if k_ov:
+        ov = stack_plan_rows([_pad_literal(lp, p_slots) for lp in seed_corpus])
+        ov = {f: jnp.asarray(getattr(ov, f)) for f in _ROW_KEYS}
+
+    def derive_keys(g, jglob):
+        # driver._derive_keys: x0 = generation, x1 = PURPOSE_EXPLORE+slot
+        return threefry2x32(
+            rk0, rk1, g, jnp.uint32(PURPOSE_EXPLORE) + jglob.astype(jnp.uint32)
+        )
+
+    def run_children(seeds, rows):
+        view = sweep(seeds, rows)
+        ok = jnp.asarray(invariant(view), jnp.bool_)
+        if ok.shape != seeds.shape:
+            raise ValueError(
+                f"invariant must return a {seeds.shape} boolean array, "
+                f"got shape {ok.shape}"
+            )
+        if require_halt:
+            ok = ok & view["halted"]
+        over = view["overflow"] > 0
+        if wl.history is not None:
+            over = over | (view["hist_drop"] > 0)
+        cols = dict(
+            trace=view["trace"],
+            halt=view["halt_time"],
+            failing=(~ok) & (~over),
+            # overflowed seeds are quarantined from guidance too: their
+            # trajectories dropped events, so their bitmaps are artifacts
+            cov=jnp.where(over[:, None], jnp.uint32(0), view["cov"]),
+        )
+        if metrics:
+            cols["met"] = view["met"]
+        if latency is not None:
+            cols["lat_hist"] = view["lat_hist"]
+        return cols
+
+    def _jglob():
+        dev = lax.axis_index(axes) if mesh is not None else 0
+        return dev * b_loc + jnp.arange(b_loc)
+
+    def shard_uniform(g):
+        jglob = _jglob()
+        k0s, k1s = derive_keys(g, jglob)
+        seeds = _mk_seeds(k0s, k1s)
+        rows = space.plan.compile_batch(seeds, device=True)
+        row_d = {f: jnp.asarray(getattr(rows, f)) for f in _ROW_KEYS}
+        if k_ov:
+            is_ov = (jglob < k_ov) & (g == jnp.uint32(0))
+            gi = jnp.minimum(jglob, k_ov - 1)
+            for f in _ROW_KEYS:
+                sel = is_ov.reshape((-1,) + (1,) * (row_d[f].ndim - 1))
+                row_d[f] = jnp.where(sel, ov[f][gi], row_d[f])
+        out = dict(
+            seed=seeds,
+            parent=jnp.full((b_loc,), -1, jnp.int32),
+            bslot=jglob.astype(jnp.int32),
+            **row_d,
+        )
+        out.update(run_children(seeds, PlanRows(**row_d)))
+        return out
+
+    def shard_breed(cr, g):
+        jglob = _jglob()
+        k0s, k1s = derive_keys(g, jglob)
+        fresh = _mk_seeds(k0s, k1s)
+        # frontier-first parent order: violating entries before clean
+        # ones, newest (largest slot == largest id) first — computed
+        # replicated on every device from the replicated corpus
+        slot = jnp.arange(cmax1)
+        valid = slot < cr["count"]
+        nv = (~cr["c"]["viol"]).astype(jnp.int64)
+        key = jnp.where(
+            valid,
+            nv * jnp.int64(2 * cmax1)
+            + (cr["count"].astype(jnp.int64) - slot),
+            jnp.int64(1) << 60,
+        )
+        order = jnp.argsort(key)
+        olen = jnp.minimum(
+            jnp.int64(select_top), cr["count"].astype(jnp.int64)
+        )
+        crm = dict(
+            ct=cr["c"]["time"], ck=cr["c"]["kind"], ca=cr["c"]["args"],
+            cv=cr["c"]["valid"], cn=cr["c"]["node"], cs=cr["c"]["seed"],
+            chalt=cr["c"]["halt"], cid=cr["c"]["id"],
+        )
+        ch = jax.vmap(
+            lambda a, b, c: mutator(a, b, c, order, olen, crm)
+        )(k0s, k1s, fresh)
+        out = dict(
+            seed=ch["seed"],
+            parent=ch["parent"],
+            bslot=jglob.astype(jnp.int32),
+            **{f: ch[f] for f in _ROW_KEYS},
+        )
+        out.update(
+            run_children(ch["seed"], PlanRows(**{f: ch[f] for f in _ROW_KEYS}))
+        )
+        return out
+
+    if mesh is not None:
+        from ..parallel import shard_map_nocheck
+
+        spec_b = P_(axes)
+        sm_uniform = shard_map_nocheck(
+            shard_uniform, mesh, in_specs=(P_(),), out_specs=spec_b
+        )
+        sm_breed = shard_map_nocheck(
+            shard_breed, mesh, in_specs=(P_(), P_()), out_specs=spec_b
+        )
+    else:
+        sm_uniform, sm_breed = shard_uniform, shard_breed
+
+    def admission(cr, g, out):
+        varange = jnp.arange(vcap1)
+
+        def body(acc, x):
+            gm, cnt, nid, vc, vs, vt, over = acc
+            row, fail, seed, trace = x
+            fresh_bits = (
+                lax.population_count(row & ~gm).sum().astype(jnp.int32)
+            )
+            gm2 = gm | row
+            # a violation is counted once per distinct (seed, trace)
+            # trajectory (driver seen_viol) — the store IS the set
+            dup_v = jnp.any((vs == seed) & (vt == trace) & (varange < vc))
+            fresh_viol = fail & ~dup_v
+            qualify = (fresh_bits > 0) | fresh_viol
+            idj = jnp.where(qualify, nid, -1)
+            vslot = jnp.where(fresh_viol, jnp.minimum(vc, vcap), -1)
+            wv = jnp.minimum(vc, vcap)
+            vs2 = vs.at[wv].set(jnp.where(fresh_viol, seed, vs[wv]))
+            vt2 = vt.at[wv].set(jnp.where(fresh_viol, trace, vt[wv]))
+            over2 = over | (fresh_viol & (vc >= vcap))
+            cslot = jnp.where(qualify & (cnt < max_corpus), cnt, -1)
+            acc2 = (
+                gm2,
+                cnt + (qualify & (cnt < max_corpus)).astype(jnp.int32),
+                nid + qualify.astype(jnp.int32),
+                vc + fresh_viol.astype(jnp.int32),
+                vs2, vt2, over2,
+            )
+            return acc2, (fresh_bits, idj, cslot, vslot)
+
+        (gm2, cnt2, nid2, vc2, _, _, over2), ys = lax.scan(
+            body,
+            (
+                cr["gmap"], cr["count"], cr["next_id"], cr["vcount"],
+                cr["v"]["seed"], cr["v"]["trace"], cr["over"],
+            ),
+            (out["cov"], out["failing"], out["seed"], out["trace"]),
+        )
+        fresh_bits, ids, cslot, vslot = ys
+        gen_col = jnp.full((batch,), g.astype(jnp.int32))
+
+        def scatter(store, slots, trash):
+            idx = jnp.where(slots >= 0, slots, trash)
+            s2 = dict(store)
+            for f in _ROW_KEYS:
+                s2[f] = store[f].at[idx].set(out[f])
+            s2["seed"] = store["seed"].at[idx].set(out["seed"])
+            s2["trace"] = store["trace"].at[idx].set(out["trace"])
+            s2["cov"] = store["cov"].at[idx].set(out["cov"])
+            s2["new_bits"] = store["new_bits"].at[idx].set(fresh_bits)
+            s2["id"] = store["id"].at[idx].set(ids)
+            s2["parent"] = store["parent"].at[idx].set(out["parent"])
+            s2["gen"] = store["gen"].at[idx].set(gen_col)
+            s2["viol"] = store["viol"].at[idx].set(out["failing"])
+            s2["halt"] = store["halt"].at[idx].set(out["halt"])
+            s2["bslot"] = store["bslot"].at[idx].set(out["bslot"])
+            return s2
+
+        cr2 = dict(
+            c=scatter(cr["c"], cslot, max_corpus),
+            v=scatter(cr["v"], vslot, vcap),
+            gmap=gm2,
+            count=cnt2,
+            next_id=nid2,
+            vcount=vc2,
+            over=over2,
+        )
+        summary = dict(
+            count=cnt2,
+            next_id=nid2,
+            vcount=vc2,
+            admitted=(cslot >= 0).sum().astype(jnp.int32),
+            cov_bits=lax.population_count(gm2).sum().astype(jnp.int32),
+            over=over2,
+        )
+        return cr2, summary
+
+    def prog(cr, g, breed: bool):
+        out = (sm_breed(cr, g) if breed else sm_uniform(g))
+        if mesh is not None:
+            # gather the generation's per-seed rows onto every device
+            # before the admission scan: the scan is inherently
+            # sequential (batch-order semantics), and scanning over
+            # batch-sharded xs trips the SPMD partitioner (mixed-width
+            # index arithmetic in the per-iteration slices). One
+            # all-gather of (batch, slots) rows per generation — still
+            # device-resident, never the host. The met/lat_hist tap
+            # columns stay SHARDED: the admission scan never reads
+            # them, and merge_metrics/merge_latency fold them as
+            # per-device local sums (D rows to the host, no gather).
+            rep = NamedSharding(mesh, P_())
+            out = {
+                k: (v if k in ("met", "lat_hist")
+                    else lax.with_sharding_constraint(v, rep))
+                for k, v in out.items()
+            }
+        cr2, summary = admission(cr, g, out)
+        extras = {
+            k: out[k] for k in ("met", "lat_hist") if k in out
+        }
+        return cr2, summary, extras
+
+    prog_uniform = jax.jit(lambda cr, g: prog(cr, g, False))
+    prog_breed = jax.jit(lambda cr, g: prog(cr, g, True))
+
+    # ---- materialization ----
+    def _entry_name(gen, parent, bslot, seed):
+        if parent >= 0:
+            return f"g{gen}p{parent}"
+        if gen == 0 and 0 <= bslot < k_ov:
+            return seed_corpus[bslot].name
+        return f"{space.plan.name}@{seed}"
+
+    def _materialize(carry_host):
+        cn = {k: np.asarray(v) for k, v in carry_host["c"].items()}
+        vn = {k: np.asarray(v) for k, v in carry_host["v"].items()}
+        n_c = int(carry_host["count"])
+        n_v = int(carry_host["vcount"])
+        for i in range(len(c_cache), n_c):
+            c_cache[i] = _store_entry(
+                cn, i,
+                _entry_name(int(cn["gen"][i]), int(cn["parent"][i]),
+                            int(cn["bslot"][i]), int(cn["seed"][i])),
+            )
+        corpus = [c_cache[i] for i in range(n_c)]
+        by_id = {e.id: e for e in corpus}
+        for i in range(len(v_cache), min(n_v, vcap)):
+            eid = int(vn["id"][i])
+            # a violating entry that also joined the corpus is the SAME
+            # object in both lists (the host driver's sharing)
+            v_cache[i] = by_id.get(eid) or _store_entry(
+                vn, i,
+                _entry_name(int(vn["gen"][i]), int(vn["parent"][i]),
+                            int(vn["bslot"][i]), int(vn["seed"][i])),
+            )
+        violations = [v_cache[i] for i in range(min(n_v, vcap))]
+        return corpus, violations, np.asarray(carry_host["gmap"], np.uint32)
+
+    def _snapshot(gens_done):
+        from .persist import CampaignState
+
+        corpus, violations, gm = _materialize(jax.device_get(carry))
+        return CampaignState(
+            workload=wl.name, config_hash=cfg.hash(),
+            plan_hash=space.hash(), root_seed=int(root_seed), batch=batch,
+            cov_words=cov_words, cov_hitcount=cov_hitcount,
+            generations_done=gens_done, next_id=int(carry_np_next_id[0]),
+            sims=sims, curve=list(curve), viol_curve=list(viol_curve),
+            cov_map=gm.copy(), corpus=list(corpus),
+            violations=list(violations),
+        )
+
+    def _emit(record):
+        if telemetry is not None:
+            telemetry(record)
+
+    _emit({
+        "event": "campaign_start", "workload": wl.name,
+        "config_hash": cfg.hash(), "plan_hash": space.hash(),
+        "root_seed": int(root_seed), "batch": batch,
+        "generations": generations, "cov_words": cov_words,
+        "cov_hitcount": cov_hitcount, "resumed_at_generation": g_start,
+        "driver": "device", "mesh_devices": n_dev,
+    })
+
+    wall_dispatch = 0.0
+    wall_sync = 0.0
+    host_syncs = 0
+    carry_np_next_id = [next_id0]  # host mirror for snapshots
+    vcount_host = len(loaded_viol)
+
+    for g in range(g_start, g_start + generations):
+        t0 = _time.monotonic()  # lint: allow(wall-clock)
+        breed = g > 0 and count > 0
+        runner = prog_breed if breed else prog_uniform
+        carry, summary, extras = runner(carry, jnp.uint32(g))
+        jax.block_until_ready(summary)
+        t1 = _time.monotonic()  # lint: allow(wall-clock)
+        # THE host sync: admission summary + banner counters only —
+        # per-seed state stays on device
+        s = jax.device_get(summary)
+        host_syncs += 1
+        fleet = {}
+        if extras:
+            from .. import parallel as _par
+
+            if "met" in extras:
+                fleet["met_total"] = [
+                    int(x) for x in _par.merge_metrics(extras["met"], mesh)
+                ]
+            if "lat_hist" in extras:
+                fleet["lat_total_ops"] = int(
+                    _par.merge_latency(extras["lat_hist"], mesh).sum()
+                )
+        t2 = _time.monotonic()  # lint: allow(wall-clock)
+        if bool(s["over"]):
+            raise RuntimeError(
+                f"device violation store overflowed (viol_cap={vcap}) at "
+                f"generation {g}: the (seed, trace) dedup can no longer "
+                f"match the host driver — raise viol_cap"
+            )
+        sims += batch
+        count = int(s["count"])
+        carry_np_next_id[0] = int(s["next_id"])
+        new_viol = int(s["vcount"]) - vcount_host
+        vcount_host = int(s["vcount"])
+        curve.append(int(s["cov_bits"]))
+        viol_curve.append(vcount_host)
+        wall_dispatch += t1 - t0
+        wall_sync += t2 - t1
+        if log is not None:
+            log(
+                f"explore[device] g{g}: {curve[-1]} coverage bits "
+                f"(+{int(s['admitted'])} corpus entries, corpus {count}), "
+                f"{vcount_host} violations"
+            )
+        _emit({
+            "event": "generation", "generation": g, "sims": sims,
+            "cov_bits": curve[-1], "new_entries": int(s["admitted"]),
+            "corpus_size": count, "violations": vcount_host,
+            "new_violations": new_viol,
+            "dispatch_wall_s": round(t1 - t0, 3),
+            "sync_wall_s": round(t2 - t1, 3),
+            "host_syncs": 1, **fleet,
+        })
+        if checkpoint_path is not None:
+            _snapshot(g + 1).save(checkpoint_path)
+
+    _emit({
+        "event": "campaign_end", "generations": g_start + generations,
+        "generations_run": generations,
+        "sims": sims, "cov_bits": curve[-1] if curve else 0,
+        "corpus_size": count, "violations": vcount_host,
+        "wall_dispatch_s": round(wall_dispatch, 3),
+        "wall_sync_s": round(wall_sync, 3), "host_syncs": host_syncs,
+    })
+    corpus, violations, gm = _materialize(jax.device_get(carry))
+    return ExploreReport(
+        workload=wl.name,
+        config_hash=cfg.hash(),
+        plan_hash=space.hash(),
+        root_seed=int(root_seed),
+        generations=g_start + generations,
+        batch=batch,
+        max_steps=max_steps,
+        cov_words=cov_words,
+        sims=sims,
+        corpus=corpus,
+        violations=violations,
+        cov_map=gm,
+        curve=curve,
+        viol_curve=viol_curve,
+        next_id=carry_np_next_id[0],
+        cov_hitcount=cov_hitcount,
+        wall_dispatch_s=wall_dispatch,
+        wall_host_s=wall_sync,
+        host_syncs=host_syncs,
+        wall_gens=generations,
+    )

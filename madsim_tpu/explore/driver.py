@@ -43,7 +43,7 @@ from ..engine.core import KIND_NOP
 from ..engine.rng import PURPOSE_EXPLORE, np_threefry2x32v
 from ..engine.search import SearchReport, search_seeds
 from .coverage import admit, popcount
-from .mutate import HostStream, PlanSpace, mutate_plan
+from .mutate import HostStream, PlanSpace, inherit_threshold, mutate_plan
 
 __all__ = ["CorpusEntry", "ExploreReport", "replay_entry", "run"]
 
@@ -96,6 +96,23 @@ class ExploreReport:
     # (engine cov_hitcount): bucketed and set-only bitmaps are different
     # coordinate systems, so resume refuses a flag mismatch
     cov_hitcount: bool = False
+    # per-generation wall split, summed over the campaign: time inside
+    # the batched device dispatch vs time the host spent driving it
+    # (mutation + admission + corpus bookkeeping on the host driver;
+    # the one summary fetch on the device driver). The split is also in
+    # every telemetry "generation" record, so the one-host-sync claim
+    # of the device driver is measurable from the artifact.
+    wall_dispatch_s: float = 0.0
+    wall_host_s: float = 0.0
+    # summary-only host synchronization points (explore.run_device: one
+    # per generation). 0 = host-driven campaign, where every generation
+    # moves per-seed state to the host and the notion does not apply.
+    host_syncs: int = 0
+    # generations the wall split / host_syncs cover: a RESUMED
+    # campaign's timers cover only the resumed run, while
+    # ``generations`` counts from generation 0 — the banner pairs
+    # syncs against this, not the absolute total
+    wall_gens: int = 0
 
     @property
     def coverage_bits(self) -> int:
@@ -112,6 +129,23 @@ class ExploreReport:
             f"entries, curve {self.curve}",
             f"  violations: {len(self.violations)}",
         ]
+        if self.wall_dispatch_s or self.wall_host_s:
+            total = self.wall_dispatch_s + self.wall_host_s
+            frac = self.wall_host_s / total if total else 0.0
+            gens = max(self.wall_gens or self.generations, 1)
+            if self.host_syncs:
+                lines.append(
+                    f"  wall: {self.wall_dispatch_s:.2f}s device dispatch "
+                    f"+ {self.wall_host_s:.2f}s host sync "
+                    f"({frac:.1%} host; {self.host_syncs} summary syncs "
+                    f"/ {gens} generations)"
+                )
+            else:
+                lines.append(
+                    f"  wall: {self.wall_dispatch_s:.2f}s batched dispatch "
+                    f"+ {self.wall_host_s:.2f}s host-driven loop "
+                    f"({frac:.1%} host)"
+                )
         for e in self.violations[:limit]:
             lines.append(
                 f"  violation g{e.generation} id{e.id}: seed {e.seed} "
@@ -311,24 +345,10 @@ def run(
         )
     dup = space.uses_dup()
     if resume is not None:
-        from .persist import CampaignState
+        from .persist import resolve_resume
 
-        st = CampaignState.load(resume) if isinstance(resume, str) else resume
-        for what, got, want in (
-            ("workload", st.workload, wl.name),
-            ("plan-space hash", st.plan_hash, space.hash()),
-            ("config hash", st.config_hash, cfg.hash()),
-            ("root seed", st.root_seed, int(root_seed)),
-            ("batch", st.batch, batch),
-            ("cov_words", st.cov_words, cov_words),
-            ("cov_hitcount", st.cov_hitcount, cov_hitcount),
-        ):
-            if got != want:
-                raise ValueError(
-                    f"campaign checkpoint {what} mismatch: saved {got!r}, "
-                    f"this run has {want!r} — resuming would break the "
-                    f"pure-function-of-root-seed contract"
-                )
+        st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
+                            cov_words, cov_hitcount)
         global_map = np.asarray(st.cov_map, np.uint32).copy()
         corpus = list(st.corpus)
         by_id = {e.id: e for e in corpus}
@@ -376,7 +396,10 @@ def run(
         "cov_hitcount": cov_hitcount, "resumed_at_generation": g_start,
     })
 
+    wall_dispatch = 0.0
+    wall_host = 0.0
     for g in range(g_start, g_start + generations):
+        t_gen = _time.monotonic()  # lint: allow(wall-clock)
         k0s, k1s = _derive_keys(root_seed, g, batch)
         seeds = _child_seeds(k0s, k1s)
         overrides: dict[int, LiteralPlan] = {}
@@ -426,7 +449,7 @@ def run(
                 # so a near-miss fault alignment can be tuned instead
                 # of re-rolled (the rest re-key both, keeping
                 # seed-space exploration alive)
-                if st.bits() < int(inherit_seed_p * (1 << 32)):
+                if st.bits() < inherit_threshold(inherit_seed_p):
                     seeds[j] = np.uint64(by_id[pid].seed)
                 parent = by_id[pid]
                 plans.append(
@@ -493,19 +516,30 @@ def run(
                 f"corpus entries, corpus {len(corpus)}), "
                 f"{len(violations)} violations"
             )
+        # host-side share of this generation's wall: parent selection,
+        # mutation, plan stacking, admission bookkeeping — everything
+        # that is NOT the batched dispatch (the split the device driver
+        # collapses to one summary sync)
+        host_wall = (_time.monotonic() - t_gen) - dispatch_wall  # lint: allow(wall-clock)
+        wall_dispatch += dispatch_wall
+        wall_host += host_wall
         _emit({
             "event": "generation", "generation": g, "sims": sims,
             "cov_bits": curve[-1], "new_entries": admitted,
             "corpus_size": len(corpus), "violations": len(violations),
             "dispatch_wall_s": round(dispatch_wall, 3),
+            "host_wall_s": round(host_wall, 3),
         })
         if checkpoint_path is not None:
             _snapshot(g + 1).save(checkpoint_path)
 
     _emit({
         "event": "campaign_end", "generations": g_start + generations,
+        "generations_run": generations,
         "sims": sims, "cov_bits": curve[-1] if curve else 0,
         "corpus_size": len(corpus), "violations": len(violations),
+        "wall_dispatch_s": round(wall_dispatch, 3),
+        "wall_host_s": round(wall_host, 3),
     })
     return ExploreReport(
         workload=wl.name,
@@ -524,4 +558,7 @@ def run(
         viol_curve=viol_curve,
         next_id=next_id,
         cov_hitcount=cov_hitcount,
+        wall_dispatch_s=wall_dispatch,
+        wall_host_s=wall_host,
+        wall_gens=generations,
     )

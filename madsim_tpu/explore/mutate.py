@@ -32,7 +32,30 @@ from ..chaos.plan import FaultPlan, LiteralPlan
 from ..engine.core import pack_slow_arg
 from ..engine.rng import np_threefry2x32
 
-__all__ = ["HostStream", "PlanSpace", "mutate_plan"]
+__all__ = ["HostStream", "PlanSpace", "mutate_plan", "mutation_table"]
+
+# Effective retarget modes, one per plan slot — the static resolution of
+# _retarget's condition chain (arg_kind x target-count). MODE_RETIME is
+# the fallback: args are fixed for the slot, so a retarget op perturbs
+# the time instead. The device mutator (explore/device.py) branches on
+# these the same way the host chain does; mutation_table() is the one
+# place the resolution happens, so the two implementations cannot
+# disagree about which slot takes which branch.
+MODE_NODE, MODE_PAIR, MODE_SLOW, MODE_SKEW, MODE_RETIME = range(5)
+
+# draws a retarget consumes per mode (node: 1 pick; pair: 2 picks;
+# slow: 2 picks + mult; skew: pick + skew; fallback: retime's 2) — the
+# device mutator advances its draw counter by exactly these amounts so
+# its stream stays draw-for-draw aligned with HostStream's edit script
+RETARGET_DRAWS = (1, 2, 3, 2, 2)
+
+
+def inherit_threshold(inherit_seed_p: float) -> int:
+    """The 32-bit draw threshold below which a child inherits its
+    parent's engine seed. Parity-critical like RETARGET_DRAWS: both
+    campaign drivers compare the same draw against this SAME integer,
+    so the probability->threshold mapping must resolve in one place."""
+    return int(inherit_seed_p * (1 << 32))
 
 
 class HostStream:
@@ -86,6 +109,19 @@ class PlanSpace:
                 f"plan {plan.name!r} exposes {len(self.templates)} slot "
                 f"templates for {plan.slots} slots"
             )
+        for i, t in enumerate(self.templates):
+            # a pair/slow retarget draws "some OTHER target": with one
+            # distinct value the host stream would pick from an empty
+            # list (ZeroDivisionError) while the device mutator would
+            # silently breed b == a — refuse the space up front so both
+            # drivers fail identically and loudly
+            if t.arg_kind in ("pair", "slow") and len(t.targets) >= 2 \
+                    and len(set(t.targets)) < 2:
+                raise ValueError(
+                    f"plan {plan.name!r} slot {i} ({t.arg_kind}) needs "
+                    f">= 2 distinct targets to retarget, got "
+                    f"{tuple(t.targets)}"
+                )
 
     @property
     def slots(self) -> int:
@@ -96,6 +132,57 @@ class PlanSpace:
 
     def hash(self) -> str:
         return self.plan.hash()
+
+
+def _effective_mode(tmpl) -> int:
+    """Static resolution of _retarget's branch for one slot template."""
+    kind = tmpl.arg_kind
+    if kind == "node" and tmpl.targets:
+        return MODE_NODE
+    if kind == "pair" and len(tmpl.targets) >= 2:
+        return MODE_PAIR
+    if kind == "slow" and len(tmpl.targets) >= 2:
+        return MODE_SLOW
+    if kind == "skew" and tmpl.targets:
+        return MODE_SKEW
+    return MODE_RETIME
+
+
+def mutation_table(space: PlanSpace) -> dict:
+    """The space's SlotTemplate tuple as static per-slot numpy arrays —
+    the device-resident form of the mutation surface.
+
+    ``explore.device``'s vectorized mutator reads windows, target sets
+    and retarget modes from these arrays while the host mutators above
+    read the templates directly; both resolve the retarget branch
+    through :func:`_effective_mode`, and the draw-parity test pins the
+    two implementations draw-for-draw. Targets are padded to the widest
+    slot (``tcnt`` holds the live count; padding is never selected
+    because every pick reduces modulo the count).
+    """
+    tm = space.templates
+    p = len(tm)
+    width = max((len(t.targets) for t in tm), default=0) or 1
+    tgt = np.zeros((p, width), np.int64)
+    for i, t in enumerate(tm):
+        if t.targets:
+            tgt[i, : len(t.targets)] = np.asarray(t.targets, np.int64)
+    mode = np.asarray([_effective_mode(t) for t in tm], np.int32)
+    return {
+        "t_lo": np.asarray([t.t_min_ns for t in tm], np.int64),
+        # the host _retime floor: hi = max(t_max, t_min + 1)
+        "t_hi": np.asarray(
+            [max(t.t_max_ns, t.t_min_ns + 1) for t in tm], np.int64
+        ),
+        "mode": mode,
+        "rt_draws": np.asarray([RETARGET_DRAWS[m] for m in mode], np.int32),
+        "tgt": tgt,
+        "tcnt": np.asarray([len(t.targets) for t in tm], np.int32),
+        "mult_lo": np.asarray([t.mult_min for t in tm], np.int64),
+        "mult_hi": np.asarray([t.mult_max for t in tm], np.int64),
+        "skew_lo": np.asarray([t.skew_min_ns for t in tm], np.int64),
+        "skew_hi": np.asarray([t.skew_max_ns for t in tm], np.int64),
+    }
 
 
 def _retime(events, i, tmpl, stream, horizon=None):

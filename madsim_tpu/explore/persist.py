@@ -33,7 +33,8 @@ import numpy as np
 from ..chaos.plan import LiteralPlan
 from .driver import CorpusEntry
 
-__all__ = ["CampaignState", "save_campaign", "load_campaign"]
+__all__ = ["CampaignState", "resolve_resume", "save_campaign",
+           "load_campaign"]
 
 _FORMAT = 1
 
@@ -180,6 +181,32 @@ class CampaignState:
     def load(cls, path: str) -> "CampaignState":
         with open(path) as fh:
             return cls.from_dict(json.load(fh))
+
+
+def resolve_resume(resume, wl, space, cfg, root_seed: int, batch: int,
+                   cov_words: int, cov_hitcount: bool) -> CampaignState:
+    """Load (path or state) and validate a campaign checkpoint against
+    this run's arguments — shared by BOTH campaign drivers
+    (explore.run and explore.run_device), so a field added to the
+    identity tuple cannot be validated on one path and silently
+    accepted on the other."""
+    st = CampaignState.load(resume) if isinstance(resume, str) else resume
+    for what, got, want in (
+        ("workload", st.workload, wl.name),
+        ("plan-space hash", st.plan_hash, space.hash()),
+        ("config hash", st.config_hash, cfg.hash()),
+        ("root seed", st.root_seed, int(root_seed)),
+        ("batch", st.batch, batch),
+        ("cov_words", st.cov_words, cov_words),
+        ("cov_hitcount", st.cov_hitcount, cov_hitcount),
+    ):
+        if got != want:
+            raise ValueError(
+                f"campaign checkpoint {what} mismatch: saved {got!r}, "
+                f"this run has {want!r} — resuming would break the "
+                f"pure-function-of-root-seed contract"
+            )
+    return st
 
 
 def save_campaign(path: str, report) -> CampaignState:

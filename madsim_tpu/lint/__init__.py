@@ -34,6 +34,7 @@ jaxpr matrix.
 
 from .taint import TaintEqn, TaintResult, analyze_jaxpr  # noqa: F401
 from .noninterference import (  # noqa: F401
+    CAMPAIGN_AXES,
     NonInterferenceReport,
     check_matrix,
     check_noninterference,
@@ -54,6 +55,7 @@ __all__ = [
     "TaintEqn",
     "TaintResult",
     "analyze_jaxpr",
+    "CAMPAIGN_AXES",
     "NonInterferenceReport",
     "check_matrix",
     "check_noninterference",

@@ -49,6 +49,7 @@ __all__ = [
     "model_matrix",
     "plant_met_leak",
     "BUILD_AXES",
+    "CAMPAIGN_AXES",
     "LAYOUT_AXES",
 ]
 
@@ -148,7 +149,11 @@ def check_noninterference(
     frontier is then readable. ``entry="run"`` traces
     ``make_run(n_steps)`` over a batched state, which routes the whole
     proof through a vmapped ``lax.scan`` body (the loop-carry fixpoint
-    path). ``mutate`` optionally wraps the traced function (the planted
+    path). ``entry="sharded_run"`` traces the same batched run under
+    ``shard_map`` across every available device — the multi-chip
+    campaign program (explore.run_device's simulate stage), proved
+    through the shard_map call boundary (the batch is rounded up to
+    the device count). ``mutate`` optionally wraps the traced function (the planted
     leak mutants use it); it receives and returns a
     ``SimState -> SimState`` callable.
     """
@@ -185,8 +190,37 @@ def check_noninterference(
             placement=placement, **obs_kw,
         )
         template = state
+    elif entry == "sharded_run":
+        # the multi-chip campaign program (explore.run_device's simulate
+        # stage): the batched run under shard_map across every available
+        # device — the proof walks THROUGH the shard_map call boundary
+        # (lint.taint) instead of stopping at it. The per-shard body is
+        # the same make_run scan, so a leak inside a shard is reported
+        # with its nested eqns[..].shard_map.body path.
+        from jax.sharding import PartitionSpec as _P
+
+        from .. import parallel as _par
+
+        mesh = _par.make_mesh()
+        n_dev = int(mesh.devices.size)
+        flags["mesh_devices"] = n_dev
+        rows = max(n_seeds, n_dev)
+        if rows % n_dev:
+            rows += n_dev - rows % n_dev
+        state = init(np.zeros(rows, np.uint64))
+        run_fn = make_run(
+            wl, cfg, n_steps, layout=layout, time32=time32,
+            placement=placement, **obs_kw,
+        )
+        spec = _P(mesh.axis_names)
+        fn = _par.shard_map_nocheck(
+            run_fn, mesh, in_specs=spec, out_specs=spec
+        )
+        template = state
     else:
-        raise ValueError(f"unknown entry {entry!r} (step or run)")
+        raise ValueError(
+            f"unknown entry {entry!r} (step, run, or sharded_run)"
+        )
     if mutate is not None:
         fn = mutate(fn)
 
@@ -314,6 +348,19 @@ LAYOUT_AXES = (
     ("scatter", True, "rank"),
     ("dense", True, None),
 )
+
+# The sharded-campaign matrix entry (ROADMAP lint follow-on; required
+# before pod-scale campaigns are load-bearing): the device campaign's
+# tap set — coverage guidance + fleet metrics + latency sketches, the
+# derived columns explore.run_device folds while the simulate stage
+# runs under shard_map — proved through the shard_map call boundary
+# with entry="sharded_run". Sweep it as
+# ``check_matrix(models, CAMPAIGN_AXES, entry="sharded_run")``.
+CAMPAIGN_AXES = {
+    "sharded-campaign": dict(
+        cov_words=8, metrics=True, latency=LatencySpec(ops=8, phases=2),
+    ),
+}
 
 def model_matrix() -> list:
     """(name, workload, config) triples for the four recorded models.

@@ -7,6 +7,14 @@ the input labels. The only real work is the higher-order primitives:
 
 * ``pjit`` / call-like primitives — recurse into the sub-jaxpr with the
   call-site labels mapped onto its invars.
+* ``shard_map`` — the multi-chip call boundary (madsim_tpu.parallel):
+  per-shard invars map 1:1 onto the call-site operands (the mesh and
+  sharding specs are metadata, not data), so labels cross the boundary
+  positionally; the collectives a mapped body may run (``psum`` & co)
+  are first-order equations inside and propagate like any other. This
+  is what lets the proof walk the sharded-campaign programs
+  (explore.run_device) instead of conservatively smearing every label
+  across the whole generation.
 * ``cond`` (which ``lax.switch`` lowers to) — outputs join over every
   branch, PLUS the predicate's labels: a tainted branch index is an
   implicit flow (which value you got depends on tainted data), and a
@@ -228,6 +236,25 @@ def _propagate(jaxpr, in_taints, path, rows, defs=None, env_out=None):
             )
             ys = [y | o for y, o in zip(ys, final[ncar:])]
             out_ts = carry + ys
+        elif name == "shard_map":
+            # the multi-chip call boundary: params["jaxpr"] is the
+            # per-shard body whose invars line up 1:1 with the eqn's
+            # operands (mesh/in_names/out_names are metadata). Explicit
+            # rather than via the generic single-sub-jaxpr path so a
+            # future param-shape change (a renamed param, a changed
+            # arity) degrades to the conservative fallback instead of
+            # silently mis-mapping labels.
+            sub = eqn.params.get("jaxpr")
+            if sub is not None:
+                sub = _unclose(sub)
+                if len(sub.invars) == len(in_ts):
+                    out_ts = _call_sub(
+                        sub, in_ts, f"{epath}.shard_map.", rows
+                    )
+                    if len(out_ts) != n_out:
+                        out_ts = None
+            if out_ts is None:
+                out_ts = [union] * n_out
         else:
             subs = _sub_jaxprs(eqn.params)
             if len(subs) == 1:

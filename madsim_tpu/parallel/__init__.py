@@ -26,20 +26,48 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # jax.shard_map graduated from jax.experimental after 0.4.x; the
 # replication-check kwarg was later renamed (check_rep -> check_vma),
 # NOT at the graduation boundary — so pick the kwarg by the resolved
-# function's own signature, not by which spelling exists.
+# function's own signature, not by which spelling exists. The pinned
+# jax (0.4.37) still resolves the pre-graduation fallback, so BOTH
+# halves are live code paths: tests/test_parallel.py regression-tests
+# the selection against both signatures instead of collapsing it.
 if hasattr(jax, "shard_map"):
     _shard_map = jax.shard_map
 else:  # pre-graduation JAX (e.g. 0.4.37)
     from jax.experimental.shard_map import shard_map as _shard_map
 
-try:
-    _sm_params = inspect.signature(_shard_map).parameters
-except (TypeError, ValueError):  # wrapped/builtin: assume current name
-    _sm_params = {"check_vma": None}
-_SM_NOCHECK = (
-    {"check_vma": False} if "check_vma" in _sm_params
-    else {"check_rep": False}
-)
+
+def _nocheck_kwargs(fn) -> dict:
+    """The replication-check-off kwarg for this jax's ``shard_map``.
+
+    Keyed on the resolved function's own signature (``check_vma`` on
+    current jax, ``check_rep`` before the rename); a wrapped/builtin
+    signature we cannot introspect assumes the current spelling.
+    """
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return {"check_vma": False}
+    return (
+        {"check_vma": False} if "check_vma" in params
+        else {"check_rep": False}
+    )
+
+
+_SM_NOCHECK = _nocheck_kwargs(_shard_map)
+
+
+def shard_map_nocheck(fn, mesh, in_specs, out_specs):
+    """``shard_map`` with the replication checker off — the repo's one
+    spelling of the pattern (handler branches legitimately mix
+    mesh-constant emits with shard-varying values, which the varying-
+    axes checker rejects; correctness is asserted value-wise by the
+    sharded == unsharded tests instead). Returns the unjitted mapped
+    function; callers jit it themselves."""
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **_SM_NOCHECK,
+    )
+
 
 __all__ = [
     "make_mesh",
@@ -47,6 +75,7 @@ __all__ = [
     "merge_latency",
     "merge_metrics",
     "seed_sharding",
+    "shard_map_nocheck",
     "shard_state",
     "shard_over_seeds",
     "shard_run_compacted",

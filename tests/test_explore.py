@@ -208,6 +208,17 @@ class TestMutate:
             assert child.slots == parent.slots
             parent = child
 
+    def test_degenerate_pair_targets_rejected(self):
+        # a pair/slow slot with one distinct target cannot draw "some
+        # OTHER target": the host mutator would crash mid-campaign and
+        # the device mutator would silently breed b == a — the space is
+        # refused up front on both paths instead
+        plan = FaultPlan(
+            (GrayFailure(targets=(2, 2), n_links=1),), name="degen"
+        )
+        with pytest.raises(ValueError, match="distinct targets"):
+            PlanSpace(plan)
+
 
 class TestCoverageAccounting:
     def test_admit_sequential_semantics(self):

@@ -121,6 +121,50 @@ def test_shard_over_seeds_round_trip():
     assert out.trace.sharding.mesh.shape == mesh.shape
 
 
+def test_nocheck_kwarg_selection():
+    """The replication-check-off kwarg is picked from the resolved
+    shard_map's OWN signature — both spellings are live (the pinned
+    jax still resolves the pre-graduation fallback, where the kwarg
+    is ``check_rep``; post-rename jax calls it ``check_vma``), so the
+    selection logic is regression-tested against both instead of
+    collapsing the fallback."""
+    from madsim_tpu.parallel import _SM_NOCHECK, _nocheck_kwargs, _shard_map
+
+    def old_style(f, *, mesh, in_specs, out_specs, check_rep=True):
+        pass
+
+    def new_style(f, *, mesh, in_specs, out_specs, check_vma=True):
+        pass
+
+    assert _nocheck_kwargs(old_style) == {"check_rep": False}
+    assert _nocheck_kwargs(new_style) == {"check_vma": False}
+    # an un-introspectable callable falls back to the current spelling
+    assert _nocheck_kwargs(type) == {"check_vma": False}
+    # and the module-level pick matches this jax's real shard_map
+    assert _SM_NOCHECK == _nocheck_kwargs(_shard_map)
+
+
+def test_shard_map_nocheck_smoke():
+    # the one repo spelling of the pattern: mapped body with a
+    # mesh-constant/shard-varying mix the replication checker would
+    # reject, value-equal to the unsharded computation
+    from jax.sharding import PartitionSpec as P
+
+    from madsim_tpu.parallel import shard_map_nocheck
+
+    mesh = make_mesh(jax.devices())
+    ax = mesh.axis_names
+    x = np.arange(16, dtype=np.float32)
+
+    def body(v):
+        return v * 2.0 + 1.0
+
+    out = jax.jit(
+        shard_map_nocheck(body, mesh, in_specs=P(ax), out_specs=P(ax))
+    )(x)
+    assert np.array_equal(np.asarray(out), body(x))
+
+
 def test_make_mesh_shapes():
     mesh = make_mesh(jax.devices())
     assert mesh.axis_names == ("host", "chip")

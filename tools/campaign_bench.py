@@ -1,0 +1,271 @@
+"""Campaign driver A/B: device-resident vs host-driven generations.
+The CAMPAIGN evidence artifact.
+
+Four certificates:
+
+1. **Same-box interleaved A/B** — the SAME guided campaign (workload,
+   plan space, root seed, invariant, batch, generations) is run
+   alternately by the host driver (``explore.run``: per-generation
+   numpy/Python mutation + admission bookkeeping, per-seed state to
+   the host every dispatch) and the device driver
+   (``explore.run_device``: the whole generation ONE jitted program,
+   one summary-sized host sync). Rounds interleave H,D,H,D,… so box
+   noise hits both sides equally — on this class of box only the A/B
+   ratio is meaningful, never the absolute numbers. Round 0 is the
+   warm-up (it pays XLA compilation into the persistent cache) and is
+   reported but not scored. The certificate: device ≥ 3x host
+   generations/s at ≥65k seeds per generation, with **bit-identical
+   campaign outcomes** (corpus ids, plans, traces, coverage map,
+   violation set, curves) across every run of both drivers. The hunt
+   is coverage-only (constant-true invariant): a 65k-child breeding
+   generation floods a violation store under any horizon-biased
+   predicate, and the A/B certificate is about DRIVER wall, not find
+   rate — the violation path gets its own certificate (3) where finds
+   are real.
+2. **One host sync per generation** — checked from the device driver's
+   telemetry records (every ``generation`` record carries
+   ``host_syncs: 1`` and the dispatch/sync wall split), not from this
+   module's word; the artifact prints the host-sync wall fraction.
+3. **Violation-path identity + replay** — a smaller campaign (4096
+   seeds/generation) under a halt-based invariant where finds exist:
+   both drivers must produce the identical deduped (seed, trace)
+   violation set, and a device-found violation must replay to its
+   recorded trace through the ordinary host replay path.
+4. **Guided still beats uniform at equal budget** — the lean form of
+   tools/explore_soak.py cert 1 (kvchaos lost-write mutant): the
+   guided campaign must reach strictly more coverage bits and ≥2.5x
+   the deduped violation count of a uniform nemesis sweep spending the
+   identical simulation budget. Guards the perf work against quietly
+   regressing search QUALITY.
+
+The A/B horizon is short (``MAX_STEPS`` = 64): on this CPU "device"
+the simulation step is ~2 orders slower than real accelerator silicon,
+so a long horizon buries the driver overhead both drivers share the
+sim for — the short horizon keeps the sim share comparable to what a
+TPU would give at production step counts. All raft seeds halt well
+inside the uniform-generation horizon (uniform halt fraction is
+printed as a sanity row).
+
+Usage: python tools/campaign_bench.py [batch] [gens] [rounds] [gv_budget]
+           > CAMPAIGN_r07.txt
+Defaults: batch 65536, gens 5, rounds 3 (+1 warm-up), gv_budget 2048.
+Exit 0 iff all four certificates hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore  # noqa: E402
+from madsim_tpu.chaos import (  # noqa: E402
+    CrashStorm,
+    FaultPlan,
+    GrayFailure,
+    PauseStorm,
+)
+from madsim_tpu.check import read_your_writes, stale_reads  # noqa: E402
+from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
+from madsim_tpu.models import make_kvchaos, make_raft  # noqa: E402
+
+NODES = (0, 1, 2, 3, 4)
+CFG = EngineConfig(pool_size=64, loss_p=0.02)
+# the default hunt space: composed crash + pause + gray-failure chaos
+# over the raft quorum — the explore package's stock mixed-fault shape
+PLAN = FaultPlan((
+    CrashStorm(targets=(1, 2, 3), n=2, t_min_ns=20_000_000,
+               t_max_ns=400_000_000, down_min_ns=50_000_000,
+               down_max_ns=250_000_000),
+    PauseStorm(targets=NODES, n=1, t_min_ns=20_000_000,
+               t_max_ns=300_000_000, down_min_ns=50_000_000,
+               down_max_ns=200_000_000),
+    GrayFailure(targets=NODES, n_links=1),
+), name="campaign-bench")
+MAX_STEPS = 64
+COV_WORDS = 32
+
+
+def _cov_inv(view):
+    # constant-true, same shape/dtype on both paths (ndarray | True is
+    # elementwise on the host, a traced all-true vector on the device)
+    return view["halted"] | True
+
+
+def _halt_inv(view):
+    return view["halted"]
+
+
+def _fingerprint(rep):
+    return (
+        [(e.id, e.generation, e.parent, e.seed, e.plan.hash(), e.trace,
+          e.new_bits) for e in rep.corpus],
+        rep.cov_map.tolist(),
+        [(e.seed, e.trace) for e in rep.violations],
+        rep.curve,
+        rep.viol_curve,
+    )
+
+
+def main() -> None:
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    gens = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    rounds = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+    gv_budget = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
+    failures = []
+    t_all = time.monotonic()  # lint: allow(wall-clock)
+    print(f"# campaign bench: batch {batch}, {gens} generations, "
+          f"{rounds} timed rounds (+1 warm-up), "
+          f"platform={jax.devices()[0].platform}")
+    print(f"# plan {PLAN.hash()} ({PLAN.slots} slots), raft, "
+          f"max_steps {MAX_STEPS}, cov_words {COV_WORDS}")
+
+    # horizon sanity: the uniform generation must halt comfortably
+    probe = search_seeds(
+        make_raft(), CFG,
+        lambda v: np.ones(np.asarray(v["halted"]).shape[0], bool),
+        n_seeds=4096, max_steps=MAX_STEPS, plan=PLAN,
+    )
+    print(f"# uniform halt fraction at {MAX_STEPS} steps: "
+          f"{float(np.mean(probe.halted)):.3f}")
+
+    kw = dict(generations=gens, batch=batch, root_seed=7,
+              max_steps=MAX_STEPS, cov_words=COV_WORDS, invariant=_cov_inv)
+
+    # ---- certificates 1+2: interleaved A/B ----
+    print("== cert 1: interleaved A/B, host vs device driver ==")
+    fps = []
+    walls = {"host": [], "device": []}
+    sync_fracs = []
+    telemetry_ok = True
+    for r in range(rounds + 1):
+        tag = "warmup " if r == 0 else f"round {r}"
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        rep_h = explore.run(make_raft(), CFG, PLAN, **kw)
+        wh = time.monotonic() - t0  # lint: allow(wall-clock)
+        records = []
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        rep_d = explore.run_device(
+            make_raft(), CFG, PLAN, telemetry=records.append, **kw
+        )
+        wd = time.monotonic() - t0  # lint: allow(wall-clock)
+        fps += [_fingerprint(rep_h), _fingerprint(rep_d)]
+        gen_recs = [x for x in records if x["event"] == "generation"]
+        if not (len(gen_recs) == gens
+                and all(x["host_syncs"] == 1 for x in gen_recs)):
+            telemetry_ok = False
+        dsp, snc = rep_d.wall_dispatch_s, rep_d.wall_host_s
+        frac = snc / max(dsp + snc, 1e-9)
+        print(f"  {tag}: host {wh:7.1f}s ({gens / wh:.3f} gens/s, "
+              f"{gens * batch / wh:7.0f} seeds/s) | "
+              f"device {wd:6.1f}s ({gens / wd:.3f} gens/s, "
+              f"{gens * batch / wd:7.0f} seeds/s) | "
+              f"device host-sync {snc * 1e3:.0f}ms = {frac:.2%} of wall | "
+              f"ratio {wh / wd:.2f}x")
+        if r > 0:
+            walls["host"].append(wh)
+            walls["device"].append(wd)
+            sync_fracs.append(frac)
+
+    med_h = statistics.median(walls["host"])
+    med_d = statistics.median(walls["device"])
+    ratio = med_h / med_d
+    identical = all(f == fps[0] for f in fps[1:])
+    rep = fps[0]
+    print(f"  medians: host {med_h:.1f}s vs device {med_d:.1f}s -> "
+          f"device {ratio:.2f}x generations/s "
+          f"(host-sync fraction {statistics.median(sync_fracs):.2%})")
+    print(f"  outcomes: corpus {len(rep[0])}, {len(rep[2])} violations, "
+          f"curve {rep[3]} | identical across {len(fps)} runs: {identical}")
+    if not identical:
+        failures.append("outcomes-not-bit-identical")
+    if ratio < 3.0:
+        failures.append("device-below-3x")
+    print(f"cert1 {'PASS' if identical and ratio >= 3.0 else 'FAIL'}")
+
+    print("== cert 2: one host sync per generation (telemetry) ==")
+    if not telemetry_ok:
+        failures.append("telemetry-syncs")
+    print(f"  every generation record: host_syncs=1 -> {telemetry_ok}")
+    print(f"cert2 {'PASS' if telemetry_ok else 'FAIL'}")
+
+    # ---- certificate 3: violation-path identity + replay ----
+    print("== cert 3: violation identity + replay (4096 seeds/gen) ==")
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    vkw = dict(generations=3, batch=4096, root_seed=7, max_steps=96,
+               cov_words=COV_WORDS, invariant=_halt_inv)
+    rep_h = explore.run(make_raft(), CFG, PLAN, **vkw)
+    rep_d = explore.run_device(make_raft(), CFG, PLAN, **vkw)
+    v_same = _fingerprint(rep_h) == _fingerprint(rep_d)
+    replay_ok = bool(rep_d.violations)
+    if rep_d.violations:
+        e = rep_d.violations[0]
+        r = explore.replay_entry(
+            make_raft(), CFG, e, invariant=_halt_inv, max_steps=96,
+        )
+        replay_ok = (int(r.traces[0]) == e.trace
+                     and int(r.failing_seeds[0]) == e.seed)
+    print(f"  violations host {len(rep_h.violations)} == device "
+          f"{len(rep_d.violations)}, identical {v_same}, "
+          f"replay {replay_ok} ({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+    if not (v_same and replay_ok):
+        failures.append("violation-identity")
+    print(f"cert3 {'PASS' if v_same and replay_ok else 'FAIL'}")
+
+    # ---- certificate 4: guided-vs-uniform quality guard ----
+    print("== cert 4: guided vs uniform at equal budget "
+          f"({gv_budget} sims/side) ==")
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    wl_bug = make_kvchaos(writes=10, record=True, bug=True, chaos=False)
+    kv_cfg = EngineConfig(pool_size=192, loss_p=0.05)
+    kv_plan = FaultPlan((
+        CrashStorm(targets=(1, 2, 3, 4), n=2, t_min_ns=20_000_000,
+                   t_max_ns=400_000_000, down_min_ns=50_000_000,
+                   down_max_ns=250_000_000),
+    ), name="kv-nemesis")
+    kv_steps, cw = 4000, 64
+    box = {}
+
+    def hinv(h):
+        box["ok"] = stale_reads(h) & read_your_writes(h)
+        return box["ok"]
+
+    rep_u = search_seeds(
+        wl_bug, kv_cfg, None, n_seeds=gv_budget, max_steps=kv_steps,
+        history_invariant=hinv, plan=kv_plan, cov_words=cw,
+    )
+    u_viol = int((~box["ok"] & ~rep_u.overflowed).sum())
+    u_bits = explore.popcount(
+        explore.merge(np.where(rep_u.overflowed[:, None], 0, rep_u.cov))
+    )
+    g = 8
+    rep_e = explore.run(
+        wl_bug, kv_cfg, kv_plan,
+        history_invariant=lambda h: stale_reads(h) & read_your_writes(h),
+        generations=g, batch=gv_budget // g, root_seed=7,
+        max_steps=kv_steps, cov_words=cw, max_ops=1, inherit_seed_p=0.9,
+    )
+    gv = len(rep_e.violations) / max(u_viol, 1)
+    print(f"  uniform: {u_viol} violations, {u_bits} bits | guided: "
+          f"{len(rep_e.violations)} violations, {rep_e.coverage_bits} bits "
+          f"-> {gv:.2f}x ({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+    gv_ok = rep_e.coverage_bits > u_bits and gv >= 2.5
+    if not gv_ok:
+        failures.append("guided-quality-regressed")
+    print(f"cert4 {'PASS' if gv_ok else 'FAIL'}")
+
+    print(f"# total {time.monotonic() - t_all:.1f}s | "  # lint: allow(wall-clock)
+          f"{'ALL PASS' if not failures else 'FAIL: ' + ','.join(failures)}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

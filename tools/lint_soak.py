@@ -10,8 +10,11 @@ Four certificates:
    build axis (base / metrics / timeline / coverage / hit-count /
    latency / all) x every lowering pair (scatter/int64, dense, time32
    where eligible), traced via the single-seed step AND the vmapped
-   ``make_run`` scan path: every derived column provably isolated from
-   every core column and the trace fold.
+   ``make_run`` scan path, plus the sharded-campaign row (every model
+   under the campaign tap set, proved through the ``shard_map`` call
+   boundary — the program shape ``explore.run_device`` dispatches):
+   every derived column provably isolated from every core column and
+   the trace fold.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
    value-identical op reading a metrics counter into the RNG cursor)
    is caught, with the offending equation chain and the column names.
@@ -42,6 +45,7 @@ from madsim_tpu.lint import (  # noqa: E402
 )
 from madsim_tpu.lint.noninterference import (  # noqa: E402
     BUILD_AXES,
+    CAMPAIGN_AXES,
     LAYOUT_AXES,
 )
 from madsim_tpu.engine import EngineConfig  # noqa: E402
@@ -67,6 +71,14 @@ def main() -> None:
         log=lambda s: print(f"  {s}"),
     )
     bad += [r for r in run_reports if not r.ok]
+    # the pod-scale row: every model under the campaign tap set, the
+    # batched run proved THROUGH the shard_map boundary — the program
+    # shape explore.run_device dispatches every generation
+    sharded_reports = check_matrix(
+        axes=CAMPAIGN_AXES, entry="sharded_run",
+        log=lambda s: print(f"  {s}"),
+    )
+    bad += [r for r in sharded_reports if not r.ok]
     if bad:
         failures.append("noninterference")
         for r in bad:

@@ -26,12 +26,12 @@ SHELL   := /bin/bash
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
         store-soak latency-soak lint lint-soak profile clean \
-        campaign-bench
+        campaign-bench flight
 
-check: native lint test determinism bench-smoke
+check: native lint test determinism bench-smoke flight
 	@echo "== make check: all gates passed =="
 
-check-full: native lint test-full determinism bench-smoke
+check-full: native lint test-full determinism bench-smoke flight
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -118,6 +118,20 @@ CAMPAIGN_ROUNDS ?= 3
 campaign-bench:
 	$(PY) tools/campaign_bench.py $(CAMPAIGN_BATCH) $(CAMPAIGN_GENS) \
 	    $(CAMPAIGN_ROUNDS)
+
+# Flight-recorder soak (madsim_tpu/obs/flight.py + prof.py): the
+# campaign observability certificates — generation-program retraces
+# == 1 per cache key across a 3-campaign session (profiler-certified),
+# the interleaved cache A/B, flight-recorder on/off bit-identity on
+# both drivers, and the campaign Perfetto export from a
+# violation-bearing hunt. The smoke defaults below keep `make check`
+# fast; FLIGHT_BATCH=4096 FLIGHT_GENS=4 is the FLIGHT_r08.txt scale.
+FLIGHT_BATCH ?= 512
+FLIGHT_GENS  ?= 3
+FLIGHT_TRACE ?= /tmp/flight_campaign_trace.json
+flight:
+	$(PY) tools/flight_soak.py $(FLIGHT_BATCH) $(FLIGHT_GENS) \
+	    $(FLIGHT_TRACE)
 
 # Observability soak (madsim_tpu.obs): obs-off identity at soak scale,
 # device-reduced fleet metrics on OBS_SEEDS seeds, the raftlog

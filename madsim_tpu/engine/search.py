@@ -50,7 +50,11 @@ __all__ = ["SearchReport", "make_sweep", "search_seeds"]
 # compiled-run cache: repeated searches over the same (workload, config,
 # step budget, layout) — the tool's own repro workflow — reuse the XLA
 # program instead of re-tracing per call (jit's cache keys on function
-# identity, so a fresh closure per call would defeat it)
+# identity, so a fresh closure per call would defeat it). Entries hold
+# obs.prof.AotProgram wrappers, so every build is phase-timed and
+# retrace-counted (the flight-recorder attribution), and the build
+# share of a dispatch is separable from execution
+# (SearchReport.build_wall_s).
 _RUN_CACHE: dict = {}
 
 
@@ -130,16 +134,21 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
            latency)
     if key not in _RUN_CACHE:
+        # imported here: obs is a consumer of the engine — a module-level
+        # import would run the whole obs package during engine import
+        from ..obs.prof import AotProgram
+
         init, run = _build_init_run(
             wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
             dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
             timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
             latency=latency, compact=compact,
         )
-        # make_run_compacted jits internally per growth stage
+        # make_run_compacted jits internally per growth stage (its
+        # build wall stays inside dispatch — documented limitation)
         _RUN_CACHE[key] = (
             init,
-            run if compact else jax.jit(run),
+            run if compact else AotProgram("engine.search.run", key, run),
             wl,  # keep the workload alive so id() stays unique
         )
     return _RUN_CACHE[key]
@@ -165,6 +174,13 @@ class SearchReport:
     # fault-plan hash when the sweep ran under a chaos plan: the repro
     # key is then (seed, config, plan) — all three printed in the banner
     plan_hash: str = ""
+    # wall this call spent building (trace + lower + compile) its run
+    # program — nonzero only on a cold compiled-run cache entry or a
+    # signature change. Callers timing the dispatch subtract this to
+    # get pure execution wall (the explore drivers' compile_wall_s
+    # split); 0.0 on the compact path, whose staged internal jits are
+    # not separable.
+    build_wall_s: float = 0.0
     # per-seed coverage bitmaps, (S, cov_words) uint32 — None unless the
     # sweep ran with cov_words > 0 (madsim_tpu.explore)
     cov: np.ndarray | None = None
@@ -538,6 +554,7 @@ def search_seeds(
         traces=view["trace"],
         steps=int(np.asarray(out.step).max()),
         plan_hash=plan_hash or "",
+        build_wall_s=getattr(run, "last_build_s", 0.0),
         cov=np.asarray(view["cov"]) if cov_words else None,
         halt_times=np.asarray(view["halt_time"]),
         met=np.asarray(view["met"]) if metrics else None,

@@ -19,12 +19,19 @@ campaign loop restated as a device program:
   dedup), with the winners scattered into the corpus arrays;
 * the whole generation — derive keys, pick parents, mutate, simulate
   (``engine.make_sweep``), admit — is ONE jitted program per mode
-  (uniform / breeding). With a ``mesh``, mutation and simulation run
-  under ``shard_map`` across chips (corpus replicated, the (seed, plan)
-  batch sharded — the multi-process pjit shape); the cross-shard
-  metric/latency folds reuse ``parallel.merge_metrics`` /
-  ``merge_latency``, and the admission scan consumes the gathered
-  per-seed coverage rows without ever leaving the device.
+  (uniform / breeding), built once per campaign *shape* and served
+  from the generation-program cache (``_GEN_CACHE``, the
+  ``engine.search._RUN_CACHE`` discipline): the campaign root seed and
+  generation index are runtime arguments, so a multi-campaign session
+  re-traces NOTHING (profiler-certified — ``obs.prof`` counts exactly
+  one trace per cache key, where each campaign historically re-paid
+  the full trace+lower+compile from fresh closures). With a ``mesh``,
+  mutation and simulation run under ``shard_map`` across chips (corpus
+  replicated, the (seed, plan) batch sharded — the multi-process pjit
+  shape); the cross-shard metric/latency folds reuse
+  ``parallel.merge_metrics`` / ``merge_latency``, and the admission
+  scan consumes the gathered per-seed coverage rows without ever
+  leaving the device.
 
 The host sees exactly one synchronization point per generation: the
 admission summary (corpus size, new-entry count, coverage bits,
@@ -372,175 +379,72 @@ def _store_entry(st_np, i, name) -> CorpusEntry:
 
 
 # ---------------------------------------------------------------------------
-# the campaign
+# the generation-program cache
 # ---------------------------------------------------------------------------
 
+# generation-program cache, the engine.search._RUN_CACHE discipline at
+# campaign scope: run_device historically rebuilt its uniform/breed
+# programs from fresh closures EVERY call, so jit's function-identity
+# cache missed and every campaign re-paid trace+lower+compile (ROADMAP
+# item 1; the flight recorder measured it before this cache killed it).
+# Keyed on (workload identity, config, space hash, batch, build flags,
+# invariant identity, mesh, seed-corpus literals) — everything baked
+# into the traced program. The ROOT SEED is deliberately NOT in the
+# key: it enters the programs as a runtime argument, so a multi-
+# campaign session over fresh root seeds reuses one compiled program
+# per key (profiler-certified: retraces == 1). Entries hold
+# obs.prof.AotProgram pairs, so every build is phase-timed and
+# retrace-counted. Bounded FIFO (compiled executables are not free);
+# hold ONE workload/invariant object across campaigns to hit the cache,
+# exactly like engine.search.
+_GEN_CACHE: dict = {}
+_GEN_CACHE_MAX = 8
 
-def run_device(
-    wl,
-    cfg,
-    space,
-    *,
-    invariant,
-    generations: int = 8,
-    batch: int = 256,
-    root_seed: int = 0,
-    max_steps: int = 1000,
-    cov_words: int = 32,
-    layout: str | None = None,
-    require_halt: bool = False,
-    seed_corpus=(),
-    select_top: int = 32,
-    max_corpus: int = 4096,
-    max_ops: int = 3,
-    inherit_seed_p: float = 0.75,
-    log=None,
-    cov_hitcount: bool = False,
-    telemetry=None,
-    resume=None,
-    checkpoint_path: str | None = None,
-    latency=None,
-    metrics: bool = False,
-    mesh=None,
-    viol_cap: int | None = None,
-) -> ExploreReport:
-    """Run one exploration campaign with every generation device-resident.
 
-    Same contract and bit-identical outcomes as :func:`explore.run`
-    (module docstring), with these differences:
-
-    * ``invariant`` is REQUIRED and must be jnp-traceable over the final
-      state view (``{field: array} -> (S,) bool``) — it runs inside the
-      device program. ``history_invariant`` hunts need the host driver.
-    * ``mesh`` (a ``parallel.make_mesh`` Mesh) shards mutation and the
-      sweep across chips with ``shard_map``; ``batch`` must divide over
-      the device count. Sharded and unsharded campaigns are identical.
-    * ``metrics=True`` folds per-generation fleet-metric totals into the
-      telemetry records (``parallel.merge_metrics`` — per-device sums,
-      device-count rows to the host); ``latency`` likewise folds fleet
-      sketches via ``parallel.merge_latency``. Both are derived state:
-      campaign outcomes are unchanged.
-    * ``viol_cap`` bounds the device violation store (default
-      ``max_corpus``); a campaign that finds more raises instead of
-      silently breaking the (seed, trace) dedup.
-    * ``checkpoint_path`` materializes the corpus to the host after
-      every generation (that is what a checkpoint IS) — set it only
-      when resumability is worth the extra transfer.
-
-    The per-generation host sync transfers only the admission summary
-    (corpus size, new entries, coverage bits, violation count) and the
-    fresh violation keys; telemetry records carry the dispatch/sync
-    wall split and ``host_syncs: 1`` so the claim is checkable from the
-    artifact.
-    """
-    if isinstance(space, FaultPlan):
-        space = PlanSpace(space)
-    if invariant is None:
-        raise ValueError(
-            "run_device needs a traceable final-state invariant (it is "
-            "evaluated inside the device program); history_invariant "
-            "checkers run host-side — use explore.run for those hunts"
-        )
-    if cov_words < 1:
-        raise ValueError("exploration needs cov_words >= 1 (the guidance)")
-    if generations < 1 or batch < 1:
-        raise ValueError("need generations >= 1 and batch >= 1")
-    if len(seed_corpus) > batch:
-        raise ValueError(
-            f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
-        )
-    n_dev = int(mesh.devices.size) if mesh is not None else 1
-    if batch % n_dev:
-        raise ValueError(
-            f"batch={batch} does not split over {n_dev} mesh devices"
-        )
-    vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
-    dup = space.uses_dup()
-    p_slots = space.slots
-    cmax1 = int(max_corpus) + 1
-    vcap1 = vcap + 1
-
-    # host-side validations the host driver gets from search_seeds:
-    # plan targets/user kinds against the workload, and the time32
-    # horizon (checked statically over the template windows — mutation
-    # and compilation both stay inside them)
-    space.plan.compile_batch(np.zeros(1, np.uint64), wl=wl)
-    tb_np = mutation_table(space)
-    if _resolve_time32(wl, cfg, None):
-        from ..engine.core import _T32_LIMIT
-
-        lim = _T32_LIMIT - cfg.proc_max_ns - 1
-        worst = int(tb_np["t_hi"].max(initial=1)) - 1
-        if seed_corpus:
-            worst = max(
-                worst,
-                max(e.t for lp in seed_corpus for e in lp.events),
-            )
-        if worst > lim:
-            raise ValueError(
-                f"plan-space window reaches t={worst} ns, past the int32 "
-                f"time horizon ({lim} ns) active for this (workload, "
-                f"config); shrink the windows or disable time32"
-            )
-
-    # ---- resumed / fresh host mirrors ----
-    loaded_corpus: list = []
-    loaded_viol: list = []
-    if resume is not None:
-        from .persist import resolve_resume
-
-        st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
-                            cov_words, cov_hitcount)
-        if len(st.corpus) > max_corpus:
-            raise ValueError(
-                f"checkpoint carries {len(st.corpus)} corpus entries; "
-                f"max_corpus={max_corpus} cannot hold them"
-            )
-        if len(st.violations) > vcap:
-            raise ValueError(
-                f"checkpoint carries {len(st.violations)} violations; "
-                f"raise viol_cap (now {vcap})"
-            )
-        loaded_corpus = list(st.corpus)
-        loaded_viol = list(st.violations)
-        gmap0 = np.asarray(st.cov_map, np.uint32)
-        curve = list(st.curve)
-        viol_curve = list(st.viol_curve)
-        next_id0 = st.next_id
-        sims = st.sims
-        g_start = st.generations_done
-    else:
-        gmap0 = np.zeros((cov_words,), np.uint32)
-        curve = []
-        viol_curve = []
-        next_id0 = 0
-        sims = 0
-        g_start = 0
-
-    carry = dict(
-        c=_fill_store(_empty_store(cmax1, p_slots, cov_words), loaded_corpus),
-        v=_fill_store(_empty_store(vcap1, p_slots, cov_words), loaded_viol),
-        gmap=jnp.asarray(gmap0),
-        count=jnp.int32(len(loaded_corpus)),
-        next_id=jnp.int32(next_id0),
-        vcount=jnp.int32(len(loaded_viol)),
-        over=jnp.bool_(False),
+def _mesh_key(mesh):
+    """Value identity of a mesh: same devices + axes = same programs
+    (mesh OBJECTS are routinely rebuilt between campaigns)."""
+    if mesh is None:
+        return None
+    return (
+        tuple(d.id for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
     )
-    count = len(loaded_corpus)  # host mirror (decides uniform vs breed)
 
-    # materialized-entry caches: slot -> CorpusEntry. Loaded entries are
-    # returned as the same objects (names and identity survive resume);
-    # new slots materialize once and are reused by every later
-    # checkpoint/report build.
-    c_cache = {i: e for i, e in enumerate(loaded_corpus)}
-    v_cache = {i: e for i, e in enumerate(loaded_viol)}
 
-    # ---- the device programs ----
+def _gen_programs(key, builder):
+    progs = _GEN_CACHE.get(key)
+    if progs is None:
+        while len(_GEN_CACHE) >= _GEN_CACHE_MAX:
+            _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
+        progs = _GEN_CACHE[key] = builder()
+    return progs[0], progs[1]
+
+
+def _build_programs(
+    wl, cfg, space, *, invariant, batch, max_steps, cov_words, layout,
+    require_halt, select_top, max_corpus, vcap, max_ops, inherit_seed_p,
+    cov_hitcount, metrics, latency, mesh, seed_corpus, cache_key,
+):
+    """Build one cache entry: the (uniform, breed, refs) triple.
+
+    Both programs take ``(carry, g, rk0, rk1)`` — the generation index
+    and the campaign root key are runtime arguments (same threefry
+    coordinates as the host driver's ``_derive_keys``), so one compiled
+    program serves every root seed and every generation. ``refs`` pins
+    the objects whose id() participates in the cache key.
+    """
+    from ..obs.prof import AotProgram
+
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
     b_loc = batch // n_dev
     axes = mesh.axis_names if mesh is not None else None
-    rk0 = jnp.uint32(int(root_seed) & 0xFFFFFFFF)
-    rk1 = jnp.uint32((int(root_seed) >> 32) & 0xFFFFFFFF)
-    tb = {k: jnp.asarray(v) for k, v in tb_np.items()}
+    p_slots = space.slots
+    dup = space.uses_dup()
+    cmax1 = max_corpus + 1
+    vcap1 = vcap + 1
+    tb = {k: jnp.asarray(v) for k, v in mutation_table(space).items()}
     mutator = _make_child_mutator(
         tb, max_ops, inherit_threshold(inherit_seed_p)
     )
@@ -554,7 +458,7 @@ def run_device(
         ov = stack_plan_rows([_pad_literal(lp, p_slots) for lp in seed_corpus])
         ov = {f: jnp.asarray(getattr(ov, f)) for f in _ROW_KEYS}
 
-    def derive_keys(g, jglob):
+    def derive_keys(g, jglob, rk0, rk1):
         # driver._derive_keys: x0 = generation, x1 = PURPOSE_EXPLORE+slot
         return threefry2x32(
             rk0, rk1, g, jnp.uint32(PURPOSE_EXPLORE) + jglob.astype(jnp.uint32)
@@ -591,9 +495,9 @@ def run_device(
         dev = lax.axis_index(axes) if mesh is not None else 0
         return dev * b_loc + jnp.arange(b_loc)
 
-    def shard_uniform(g):
+    def shard_uniform(g, rk0, rk1):
         jglob = _jglob()
-        k0s, k1s = derive_keys(g, jglob)
+        k0s, k1s = derive_keys(g, jglob, rk0, rk1)
         seeds = _mk_seeds(k0s, k1s)
         rows = space.plan.compile_batch(seeds, device=True)
         row_d = {f: jnp.asarray(getattr(rows, f)) for f in _ROW_KEYS}
@@ -612,9 +516,9 @@ def run_device(
         out.update(run_children(seeds, PlanRows(**row_d)))
         return out
 
-    def shard_breed(cr, g):
+    def shard_breed(cr, g, rk0, rk1):
         jglob = _jglob()
-        k0s, k1s = derive_keys(g, jglob)
+        k0s, k1s = derive_keys(g, jglob, rk0, rk1)
         fresh = _mk_seeds(k0s, k1s)
         # frontier-first parent order: violating entries before clean
         # ones, newest (largest slot == largest id) first — computed
@@ -656,10 +560,12 @@ def run_device(
 
         spec_b = P_(axes)
         sm_uniform = shard_map_nocheck(
-            shard_uniform, mesh, in_specs=(P_(),), out_specs=spec_b
+            shard_uniform, mesh, in_specs=(P_(), P_(), P_()),
+            out_specs=spec_b,
         )
         sm_breed = shard_map_nocheck(
-            shard_breed, mesh, in_specs=(P_(), P_()), out_specs=spec_b
+            shard_breed, mesh, in_specs=(P_(), P_(), P_(), P_()),
+            out_specs=spec_b,
         )
     else:
         sm_uniform, sm_breed = shard_uniform, shard_breed
@@ -742,8 +648,12 @@ def run_device(
         )
         return cr2, summary
 
-    def prog(cr, g, breed: bool):
-        out = (sm_breed(cr, g) if breed else sm_uniform(g))
+    def prog(cr, g, rk0, rk1, breed: bool):
+        out = (
+            sm_breed(cr, g, rk0, rk1) if breed
+            else sm_uniform(g, rk0, rk1)
+        )
+        rep = NamedSharding(mesh, P_()) if mesh is not None else None
         if mesh is not None:
             # gather the generation's per-seed rows onto every device
             # before the admission scan: the scan is inherently
@@ -755,20 +665,233 @@ def run_device(
             # columns stay SHARDED: the admission scan never reads
             # them, and merge_metrics/merge_latency fold them as
             # per-device local sums (D rows to the host, no gather).
-            rep = NamedSharding(mesh, P_())
             out = {
                 k: (v if k in ("met", "lat_hist")
                     else lax.with_sharding_constraint(v, rep))
                 for k, v in out.items()
             }
         cr2, summary = admission(cr, g, out)
+        if mesh is not None:
+            # pin the carry's output shardings to replicated — the
+            # compiled program's carry feeds straight back in next
+            # generation, and an AOT executable (unlike jit) does not
+            # silently recompile on a sharding drift
+            cr2 = jax.tree.map(
+                lambda a: lax.with_sharding_constraint(a, rep), cr2
+            )
         extras = {
             k: out[k] for k in ("met", "lat_hist") if k in out
         }
         return cr2, summary, extras
 
-    prog_uniform = jax.jit(lambda cr, g: prog(cr, g, False))
-    prog_breed = jax.jit(lambda cr, g: prog(cr, g, True))
+    refs = (wl, invariant, mesh, latency, space)
+    return (
+        AotProgram(
+            "explore.device.uniform", (cache_key, "uniform"),
+            lambda cr, g, rk0, rk1: prog(cr, g, rk0, rk1, False),
+        ),
+        AotProgram(
+            "explore.device.breed", (cache_key, "breed"),
+            lambda cr, g, rk0, rk1: prog(cr, g, rk0, rk1, True),
+        ),
+        refs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+
+def run_device(
+    wl,
+    cfg,
+    space,
+    *,
+    invariant,
+    generations: int = 8,
+    batch: int = 256,
+    root_seed: int = 0,
+    max_steps: int = 1000,
+    cov_words: int = 32,
+    layout: str | None = None,
+    require_halt: bool = False,
+    seed_corpus=(),
+    select_top: int = 32,
+    max_corpus: int = 4096,
+    max_ops: int = 3,
+    inherit_seed_p: float = 0.75,
+    log=None,
+    cov_hitcount: bool = False,
+    telemetry=None,
+    resume=None,
+    checkpoint_path: str | None = None,
+    latency=None,
+    metrics: bool = False,
+    mesh=None,
+    viol_cap: int | None = None,
+) -> ExploreReport:
+    """Run one exploration campaign with every generation device-resident.
+
+    Same contract and bit-identical outcomes as :func:`explore.run`
+    (module docstring), with these differences:
+
+    * ``invariant`` is REQUIRED and must be jnp-traceable over the final
+      state view (``{field: array} -> (S,) bool``) — it runs inside the
+      device program. ``history_invariant`` hunts need the host driver.
+    * ``mesh`` (a ``parallel.make_mesh`` Mesh) shards mutation and the
+      sweep across chips with ``shard_map``; ``batch`` must divide over
+      the device count. Sharded and unsharded campaigns are identical.
+    * ``metrics=True`` folds per-generation fleet-metric totals into the
+      telemetry records (``parallel.merge_metrics`` — per-device sums,
+      device-count rows to the host); ``latency`` likewise folds fleet
+      sketches via ``parallel.merge_latency``. Both are derived state:
+      campaign outcomes are unchanged.
+    * ``viol_cap`` bounds the device violation store (default
+      ``max_corpus``); a campaign that finds more raises instead of
+      silently breaking the (seed, trace) dedup.
+    * ``checkpoint_path`` materializes the corpus to the host after
+      every generation (that is what a checkpoint IS) — set it only
+      when resumability is worth the extra transfer.
+
+    The per-generation host sync transfers only the admission summary
+    (corpus size, new entries, coverage bits, violation count) and the
+    fresh violation keys; telemetry records carry the
+    dispatch/compile/sync wall split and ``host_syncs: 1`` so the
+    claim is checkable from the artifact. ``compile_wall_s`` is
+    nonzero only when the generation-program cache was cold for this
+    campaign shape — hold one workload/invariant object across
+    campaigns (the ``engine.search`` rule) and every later campaign
+    runs compile-free.
+    """
+    if isinstance(space, FaultPlan):
+        space = PlanSpace(space)
+    if invariant is None:
+        raise ValueError(
+            "run_device needs a traceable final-state invariant (it is "
+            "evaluated inside the device program); history_invariant "
+            "checkers run host-side — use explore.run for those hunts"
+        )
+    if cov_words < 1:
+        raise ValueError("exploration needs cov_words >= 1 (the guidance)")
+    if generations < 1 or batch < 1:
+        raise ValueError("need generations >= 1 and batch >= 1")
+    if len(seed_corpus) > batch:
+        raise ValueError(
+            f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
+        )
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    if batch % n_dev:
+        raise ValueError(
+            f"batch={batch} does not split over {n_dev} mesh devices"
+        )
+    vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
+    p_slots = space.slots
+    cmax1 = int(max_corpus) + 1
+    vcap1 = vcap + 1
+
+    # host-side validations the host driver gets from search_seeds:
+    # plan targets/user kinds against the workload, and the time32
+    # horizon (checked statically over the template windows — mutation
+    # and compilation both stay inside them)
+    space.plan.compile_batch(np.zeros(1, np.uint64), wl=wl)
+    if _resolve_time32(wl, cfg, None):
+        from ..engine.core import _T32_LIMIT
+
+        tb_np = mutation_table(space)
+        lim = _T32_LIMIT - cfg.proc_max_ns - 1
+        worst = int(tb_np["t_hi"].max(initial=1)) - 1
+        if seed_corpus:
+            worst = max(
+                worst,
+                max(e.t for lp in seed_corpus for e in lp.events),
+            )
+        if worst > lim:
+            raise ValueError(
+                f"plan-space window reaches t={worst} ns, past the int32 "
+                f"time horizon ({lim} ns) active for this (workload, "
+                f"config); shrink the windows or disable time32"
+            )
+
+    # ---- resumed / fresh host mirrors ----
+    loaded_corpus: list = []
+    loaded_viol: list = []
+    if resume is not None:
+        from .persist import resolve_resume
+
+        st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
+                            cov_words, cov_hitcount)
+        if len(st.corpus) > max_corpus:
+            raise ValueError(
+                f"checkpoint carries {len(st.corpus)} corpus entries; "
+                f"max_corpus={max_corpus} cannot hold them"
+            )
+        if len(st.violations) > vcap:
+            raise ValueError(
+                f"checkpoint carries {len(st.violations)} violations; "
+                f"raise viol_cap (now {vcap})"
+            )
+        loaded_corpus = list(st.corpus)
+        loaded_viol = list(st.violations)
+        gmap0 = np.asarray(st.cov_map, np.uint32)
+        curve = list(st.curve)
+        viol_curve = list(st.viol_curve)
+        next_id0 = st.next_id
+        sims = st.sims
+        g_start = st.generations_done
+    else:
+        gmap0 = np.zeros((cov_words,), np.uint32)
+        curve = []
+        viol_curve = []
+        next_id0 = 0
+        sims = 0
+        g_start = 0
+
+    carry = dict(
+        c=_fill_store(_empty_store(cmax1, p_slots, cov_words), loaded_corpus),
+        v=_fill_store(_empty_store(vcap1, p_slots, cov_words), loaded_viol),
+        gmap=jnp.asarray(gmap0),
+        count=jnp.int32(len(loaded_corpus)),
+        next_id=jnp.int32(next_id0),
+        vcount=jnp.int32(len(loaded_viol)),
+        over=jnp.bool_(False),
+    )
+    if mesh is not None:
+        # commit the carry replicated up front: the cached generation
+        # programs are AOT executables pinned to their input shardings
+        # (obs.prof.AotProgram), and their outputs are constrained
+        # replicated to match — input placement must agree from the
+        # first call
+        carry = jax.device_put(carry, NamedSharding(mesh, P_()))
+    count = len(loaded_corpus)  # host mirror (decides uniform vs breed)
+
+    # materialized-entry caches: slot -> CorpusEntry. Loaded entries are
+    # returned as the same objects (names and identity survive resume);
+    # new slots materialize once and are reused by every later
+    # checkpoint/report build.
+    c_cache = {i: e for i, e in enumerate(loaded_corpus)}
+    v_cache = {i: e for i, e in enumerate(loaded_viol)}
+
+    # ---- the device programs (built once per cache key) ----
+    k_ov = len(seed_corpus)
+    key = (
+        id(wl), id(invariant), cfg.hash(), space.hash(), batch, max_steps,
+        cov_words, layout, require_halt, select_top, int(max_corpus), vcap,
+        max_ops, float(inherit_seed_p), bool(cov_hitcount), bool(metrics),
+        latency, _mesh_key(mesh), tuple(lp.hash() for lp in seed_corpus),
+    )
+    prog_uniform, prog_breed = _gen_programs(
+        key,
+        lambda: _build_programs(
+            wl, cfg, space, invariant=invariant, batch=batch,
+            max_steps=max_steps, cov_words=cov_words, layout=layout,
+            require_halt=require_halt, select_top=select_top,
+            max_corpus=int(max_corpus), vcap=vcap, max_ops=max_ops,
+            inherit_seed_p=inherit_seed_p, cov_hitcount=cov_hitcount,
+            metrics=metrics, latency=latency, mesh=mesh,
+            seed_corpus=seed_corpus, cache_key=key,
+        ),
+    )
 
     # ---- materialization ----
     def _entry_name(gen, parent, bslot, seed):
@@ -832,17 +955,27 @@ def run_device(
 
     wall_dispatch = 0.0
     wall_sync = 0.0
+    wall_compile = 0.0
     host_syncs = 0
     carry_np_next_id = [next_id0]  # host mirror for snapshots
     vcount_host = len(loaded_viol)
+    # the campaign root key enters the cached programs as a RUNTIME
+    # argument (same threefry coordinates as driver._derive_keys), so
+    # one compiled program serves every root seed
+    rk0 = jnp.uint32(int(root_seed) & 0xFFFFFFFF)
+    rk1 = jnp.uint32((int(root_seed) >> 32) & 0xFFFFFFFF)
 
     for g in range(g_start, g_start + generations):
         t0 = _time.monotonic()  # lint: allow(wall-clock)
         breed = g > 0 and count > 0
         runner = prog_breed if breed else prog_uniform
-        carry, summary, extras = runner(carry, jnp.uint32(g))
+        carry, summary, extras = runner(carry, jnp.uint32(g), rk0, rk1)
         jax.block_until_ready(summary)
         t1 = _time.monotonic()  # lint: allow(wall-clock)
+        # trace/lower/compile share of this generation (0.0 on a warm
+        # program cache — the certified steady state), split out of
+        # dispatch so warm-vs-cold comparisons compare like with like
+        compile_wall = runner.last_build_s
         # THE host sync: admission summary + banner counters only —
         # per-seed state stays on device
         s = jax.device_get(summary)
@@ -873,8 +1006,9 @@ def run_device(
         vcount_host = int(s["vcount"])
         curve.append(int(s["cov_bits"]))
         viol_curve.append(vcount_host)
-        wall_dispatch += t1 - t0
+        wall_dispatch += (t1 - t0) - compile_wall
         wall_sync += t2 - t1
+        wall_compile += compile_wall
         if log is not None:
             log(
                 f"explore[device] g{g}: {curve[-1]} coverage bits "
@@ -886,7 +1020,8 @@ def run_device(
             "cov_bits": curve[-1], "new_entries": int(s["admitted"]),
             "corpus_size": count, "violations": vcount_host,
             "new_violations": new_viol,
-            "dispatch_wall_s": round(t1 - t0, 3),
+            "dispatch_wall_s": round((t1 - t0) - compile_wall, 3),
+            "compile_wall_s": round(compile_wall, 3),
             "sync_wall_s": round(t2 - t1, 3),
             "host_syncs": 1, **fleet,
         })
@@ -899,7 +1034,9 @@ def run_device(
         "sims": sims, "cov_bits": curve[-1] if curve else 0,
         "corpus_size": count, "violations": vcount_host,
         "wall_dispatch_s": round(wall_dispatch, 3),
-        "wall_sync_s": round(wall_sync, 3), "host_syncs": host_syncs,
+        "wall_sync_s": round(wall_sync, 3),
+        "wall_compile_s": round(wall_compile, 3),
+        "host_syncs": host_syncs,
     })
     corpus, violations, gm = _materialize(jax.device_get(carry))
     return ExploreReport(
@@ -921,6 +1058,7 @@ def run_device(
         cov_hitcount=cov_hitcount,
         wall_dispatch_s=wall_dispatch,
         wall_host_s=wall_sync,
+        wall_compile_s=wall_compile,
         host_syncs=host_syncs,
         wall_gens=generations,
     )

@@ -104,6 +104,13 @@ class ExploreReport:
     # of the device driver is measurable from the artifact.
     wall_dispatch_s: float = 0.0
     wall_host_s: float = 0.0
+    # trace/lower/compile wall, split OUT of dispatch (historically the
+    # first generation's compile was billed to dispatch, skewing
+    # warm-vs-cold comparisons): nonzero only on generations that paid
+    # a program build — a warmed program cache makes this 0.0 for the
+    # whole campaign, which is exactly what the flight recorder
+    # certifies
+    wall_compile_s: float = 0.0
     # summary-only host synchronization points (explore.run_device: one
     # per generation). 0 = host-driven campaign, where every generation
     # moves per-seed state to the host and the notion does not apply.
@@ -133,18 +140,22 @@ class ExploreReport:
             total = self.wall_dispatch_s + self.wall_host_s
             frac = self.wall_host_s / total if total else 0.0
             gens = max(self.wall_gens or self.generations, 1)
+            compile_note = (
+                f" + {self.wall_compile_s:.2f}s compile (cold)"
+                if self.wall_compile_s else ""
+            )
             if self.host_syncs:
                 lines.append(
                     f"  wall: {self.wall_dispatch_s:.2f}s device dispatch "
-                    f"+ {self.wall_host_s:.2f}s host sync "
+                    f"+ {self.wall_host_s:.2f}s host sync{compile_note} "
                     f"({frac:.1%} host; {self.host_syncs} summary syncs "
                     f"/ {gens} generations)"
                 )
             else:
                 lines.append(
                     f"  wall: {self.wall_dispatch_s:.2f}s batched dispatch "
-                    f"+ {self.wall_host_s:.2f}s host-driven loop "
-                    f"({frac:.1%} host)"
+                    f"+ {self.wall_host_s:.2f}s host-driven loop"
+                    f"{compile_note} ({frac:.1%} host)"
                 )
         for e in self.violations[:limit]:
             lines.append(
@@ -398,6 +409,7 @@ def run(
 
     wall_dispatch = 0.0
     wall_host = 0.0
+    wall_compile = 0.0
     for g in range(g_start, g_start + generations):
         t_gen = _time.monotonic()  # lint: allow(wall-clock)
         k0s, k1s = _derive_keys(root_seed, g, batch)
@@ -471,7 +483,13 @@ def run(
             cov_words=cov_words, cov_hitcount=cov_hitcount,
             latency=latency,
         )
-        dispatch_wall = _time.monotonic() - t_disp  # lint: allow(wall-clock)
+        t_after = _time.monotonic()  # lint: allow(wall-clock)
+        # the trace/lower/compile share of this dispatch (nonzero only
+        # when the compiled-run cache was cold for this sweep shape) is
+        # billed to compile_wall, NOT dispatch — mixing them skewed
+        # every warm-vs-cold generations/s comparison
+        compile_wall = report.build_wall_s
+        dispatch_wall = (t_after - t_disp) - compile_wall
         sims += batch
         failing = ~report.ok & ~report.overflowed
         # overflowed seeds are quarantined from guidance too: their
@@ -519,15 +537,25 @@ def run(
         # host-side share of this generation's wall: parent selection,
         # mutation, plan stacking, admission bookkeeping — everything
         # that is NOT the batched dispatch (the split the device driver
-        # collapses to one summary sync)
-        host_wall = (_time.monotonic() - t_gen) - dispatch_wall  # lint: allow(wall-clock)
+        # collapses to one summary sync). mutate/admit are its two
+        # measured components (plan breeding before the dispatch,
+        # corpus bookkeeping after), so the campaign-Perfetto
+        # generation spans can show where the host share goes.
+        t_end = _time.monotonic()  # lint: allow(wall-clock)
+        mutate_wall = t_disp - t_gen
+        admit_wall = t_end - t_after
+        host_wall = (t_end - t_gen) - (t_after - t_disp)
         wall_dispatch += dispatch_wall
         wall_host += host_wall
+        wall_compile += compile_wall
         _emit({
             "event": "generation", "generation": g, "sims": sims,
             "cov_bits": curve[-1], "new_entries": admitted,
             "corpus_size": len(corpus), "violations": len(violations),
             "dispatch_wall_s": round(dispatch_wall, 3),
+            "compile_wall_s": round(compile_wall, 3),
+            "mutate_wall_s": round(mutate_wall, 3),
+            "admit_wall_s": round(admit_wall, 3),
             "host_wall_s": round(host_wall, 3),
         })
         if checkpoint_path is not None:
@@ -540,6 +568,7 @@ def run(
         "corpus_size": len(corpus), "violations": len(violations),
         "wall_dispatch_s": round(wall_dispatch, 3),
         "wall_host_s": round(wall_host, 3),
+        "wall_compile_s": round(wall_compile, 3),
     })
     return ExploreReport(
         workload=wl.name,
@@ -560,5 +589,6 @@ def run(
         cov_hitcount=cov_hitcount,
         wall_dispatch_s=wall_dispatch,
         wall_host_s=wall_host,
+        wall_compile_s=wall_compile,
         wall_gens=generations,
     )

@@ -50,8 +50,36 @@ __all__ = [
     "plant_met_leak",
     "BUILD_AXES",
     "CAMPAIGN_AXES",
+    "FLIGHT_AXES",
     "LAYOUT_AXES",
 ]
+
+# Host round-trip primitives: none may appear in a traced sim program.
+# The flight recorder / profiler (obs.prof, obs.flight) is host-side
+# bookkeeping by design — the matrix proves it stays that way by
+# tracing WITH a profiler active and scanning for these. The real rule
+# is the substring match (io_callback/pure_callback/debug_callback/...
+# all contain it); the set holds only the names that don't.
+_CALLBACK_PRIMS = frozenset({"outside_call"})
+
+
+def _callback_prims(jaxpr, found=None) -> list:
+    """Names of host-callback primitives anywhere in a jaxpr tree."""
+    if found is None:
+        found = set()
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if "callback" in name or name in _CALLBACK_PRIMS:
+            found.add(name)
+        for key, val in eqn.params.items():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for item in vals:
+                inner = getattr(item, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _callback_prims(inner, found)
+                elif hasattr(item, "eqns"):
+                    _callback_prims(item, found)
+    return sorted(found)
 
 
 def _leaf_names(tree) -> list:
@@ -78,10 +106,16 @@ class NonInterferenceReport:
     # tainted equations: [{path, prim, sources, mixes_clean}]
     frontier: list
     n_eqns: int
+    # host-callback primitives found anywhere in the traced program —
+    # always scanned (cheap); must be empty for sim code. With
+    # flags["flight"] the trace itself ran under an active
+    # ProgramProfiler, so a nonempty list would mean the flight taps
+    # leaked INTO the traced program.
+    callback_prims: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
-        return not self.leaks
+        return not self.leaks and not self.callback_prims
 
     def to_dict(self) -> dict:
         return {
@@ -94,6 +128,7 @@ class NonInterferenceReport:
             "leaks": self.leaks,
             "frontier": self.frontier,
             "n_eqns": self.n_eqns,
+            "callback_prims": self.callback_prims,
             "ok": self.ok,
         }
 
@@ -110,6 +145,11 @@ class NonInterferenceReport:
                 f"OK   {what}: {len(self.derived)} tainted columns stay "
                 f"isolated over {self.n_eqns} equations "
                 f"({len(self.frontier)} on the frontier)"
+            )
+        if self.callback_prims and not self.leaks:
+            return (
+                f"LEAK {what}: host-callback primitive(s) "
+                f"{self.callback_prims} inside the traced program"
             )
         lines = [f"LEAK {what}:"]
         for field, info in self.leaks.items():
@@ -142,6 +182,7 @@ def check_noninterference(
     n_steps: int = 4,
     n_seeds: int = 2,
     mutate=None,
+    flight: bool = False,
 ) -> NonInterferenceReport:
     """Prove (or refute) derived-state non-interference for one build.
 
@@ -156,6 +197,14 @@ def check_noninterference(
     the device count). ``mutate`` optionally wraps the traced function (the planted
     leak mutants use it); it receives and returns a
     ``SimState -> SimState`` callable.
+
+    ``flight=True`` performs the whole trace under an ACTIVE
+    ``obs.prof.ProgramProfiler`` — the flight-recorder boundary proof:
+    the profiler is host-side bookkeeping, so the traced program must
+    be unchanged (same equations, no host-callback primitives, taint
+    still isolated). Every report also carries ``callback_prims``: any
+    host round-trip primitive found in the traced program fails the
+    proof regardless of taint.
     """
     flags = dict(
         layout=layout, time32=time32, placement=placement, dup_rows=dup_rows,
@@ -224,7 +273,20 @@ def check_noninterference(
     if mutate is not None:
         fn = mutate(fn)
 
-    closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(template)
+    if flight:
+        # trace with the flight recorder's profiler ACTIVE: the traced
+        # program must come out identical to the profiler-off trace
+        # (the analysis below proves taint + callback-freedom; the test
+        # suite additionally pins equation-count equality)
+        from ..obs import prof as _prof
+
+        flags["flight"] = True
+        with _prof.profiled():
+            closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(
+                template
+            )
+    else:
+        closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(template)
     in_names = _leaf_names(template)
     out_names = _leaf_names(out_shape)
     derived = derived_fields(wl)
@@ -256,6 +318,7 @@ def check_noninterference(
         leaks=leaks,
         frontier=[r.to_dict() for r in result.frontier],
         n_eqns=_count_eqns(closed.jaxpr),
+        callback_prims=_callback_prims(closed.jaxpr),
     )
 
 
@@ -359,6 +422,20 @@ LAYOUT_AXES = (
 CAMPAIGN_AXES = {
     "sharded-campaign": dict(
         cov_words=8, metrics=True, latency=LatencySpec(ops=8, phases=2),
+    ),
+}
+
+# The flight-recorder boundary entry (PR 12): the campaign tap set
+# traced with an obs.prof.ProgramProfiler ACTIVE — proving the flight
+# taps (profiler, heartbeats, device-memory accounting) stay host-side:
+# the traced program carries no host-callback primitive and the taint
+# proof is unchanged. Sweep as
+# ``check_matrix(models, FLIGHT_AXES, entry="sharded_run")`` (the soak)
+# or ``entry="run"`` (the tier-1 smoke).
+FLIGHT_AXES = {
+    "flight-campaign": dict(
+        cov_words=8, metrics=True, latency=LatencySpec(ops=8, phases=2),
+        flight=True,
     ),
 }
 

@@ -28,9 +28,21 @@ zero-size arrays and bit-identical values):
   ``chaos.ClientArmy`` open-loop load): per-window p50/p90/p99/p999 +
   max for the whole fleet with only (P, B)-shaped transfer, exactly
   mergeable across shards (``parallel.merge_latency``).
+* **program profiling** (obs/prof.py) — trace/lower/compile/execute
+  wall attribution, retrace counting per cache key, HLO cost analysis
+  and device-memory accounting for every compiled program the search
+  stack dispatches (``ProgramProfiler`` + the ``AotProgram`` wrapper
+  the engine/explore program caches build through).
+* **campaign flight recorder** (obs/flight.py) — ``FlightRecorder``
+  wraps any telemetry sink with heartbeats (gens/s, ETA, HBM),
+  compile events and a closing program-table summary;
+  ``campaign_perfetto`` renders a campaign's JSONL as a Perfetto
+  timeline (generation spans + counter tracks), the campaign-scale
+  complement of the per-seed ``to_perfetto``.
 
 Evidence artifacts: ``tools/obs_soak.py`` (OBS_r09.txt),
-``tools/latency_soak.py`` (LATENCY_r12.txt).
+``tools/latency_soak.py`` (LATENCY_r12.txt),
+``tools/flight_soak.py`` (FLIGHT_r08.txt).
 """
 
 from ..engine.core import (  # noqa: F401 — the slot layout obs consumes
@@ -53,8 +65,18 @@ from .latency import (  # noqa: F401
     hist_quantile_bucket,
     latency_reduce,
 )
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    campaign_perfetto,
+    write_campaign_perfetto,
+)
 from .metrics import FleetMetrics, fleet_metrics, fleet_reduce  # noqa: F401
 from .perfetto import to_perfetto, write_perfetto  # noqa: F401
+from .prof import (  # noqa: F401
+    AotProgram,
+    ProgramProfiler,
+    device_memory,
+)
 from .telemetry import JsonlSink, explain, explain_diff  # noqa: F401
 from .timeline import (  # noqa: F401
     decode_timeline,
@@ -63,15 +85,20 @@ from .timeline import (  # noqa: F401
 )
 
 __all__ = [
+    "AotProgram",
     "FleetLatency",
     "FleetMetrics",
+    "FlightRecorder",
     "JsonlSink",
     "LAT_EDGES_NS",
     "LatencySpec",
     "METRIC_NAMES",
     "N_LAT_BUCKETS",
     "N_METRICS",
+    "ProgramProfiler",
+    "campaign_perfetto",
     "decode_timeline",
+    "device_memory",
     "explain",
     "explain_diff",
     "fleet_latency",
@@ -82,5 +109,6 @@ __all__ = [
     "refold_timeline",
     "timeline_counts",
     "to_perfetto",
+    "write_campaign_perfetto",
     "write_perfetto",
 ]

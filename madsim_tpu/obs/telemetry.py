@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 
 import numpy as np
 
@@ -41,21 +42,29 @@ __all__ = ["JsonlSink", "explain", "explain_diff"]
 
 class JsonlSink:
     """Append-mode JSONL writer usable as an ``explore.run`` telemetry
-    callable: ``sink(record_dict)`` writes one line and flushes (a
-    killed campaign keeps every completed generation's record).
+    callable: ``sink(record_dict)`` writes one line and flushes PER
+    RECORD, so a crashed or killed campaign still leaves every
+    completed generation's record readable — a flight recorder that
+    loses its tail on crash is not one. ``fsync=True`` additionally
+    forces each record to stable storage (``os.fsync``): survives the
+    whole BOX dying, at a per-record syscall cost — opt in for
+    multi-hour hunts whose telemetry is the only evidence.
     """
 
-    def __init__(self, path_or_file):
+    def __init__(self, path_or_file, fsync: bool = False):
         if hasattr(path_or_file, "write"):
             self._fh = path_or_file
             self._own = False
         else:
             self._fh = open(path_or_file, "a")
             self._own = True
+        self._fsync = fsync
 
     def __call__(self, record: dict) -> None:
         self._fh.write(json.dumps(record, sort_keys=True) + "\n")
         self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
 
     def close(self) -> None:
         if self._own:

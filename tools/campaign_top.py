@@ -1,0 +1,206 @@
+"""campaign_top — a terminal dashboard over a running campaign's JSONL.
+
+``explore.run`` / ``run_device`` with ``telemetry=obs.FlightRecorder(
+path)`` (or a bare ``obs.JsonlSink``) append one JSON record per
+campaign event; this tool tails that file and renders a one-screen
+summary that refreshes in place — the ``top`` for a multi-hour hunt:
+
+* generation progress + generations/s + ETA (from heartbeats when the
+  flight recorder stamped them, recomputed from the wall splits
+  otherwise);
+* coverage bits (with a sparkline of the whole curve), corpus size,
+  violation count;
+* the last generation's wall split (mutate / compile / dispatch /
+  admit / sync) as percentages — compile shows up ONLY on cold
+  programs, so a nonzero steady-state compile column is the re-trace
+  bug this round's cache killed;
+* device memory (live-buffer bytes from heartbeats) and profiled
+  program totals from the ``flight_summary`` once the campaign ends.
+
+Usage: python tools/campaign_top.py CAMPAIGN.jsonl [--interval 2]
+                                    [--once]
+
+Reads only; works on live, finished, and crashed (torn last line)
+logs alike. ``--once`` renders a single frame and exits (CI/smoke).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+_SPARK = "▁▂▃▄▅▆▇█"
+_WALL_KEYS = ("mutate_wall_s", "compile_wall_s", "dispatch_wall_s",
+              "admit_wall_s", "sync_wall_s")
+
+
+def read_records(path: str) -> list:
+    """Whole-file JSONL read tolerating a torn last line.
+
+    Deliberately duplicates ``obs.flight._records`` (same torn-tail
+    rule) rather than importing it: the dashboard must start in
+    milliseconds and run on boxes without jax — importing madsim_tpu
+    pulls the whole engine. Keep the two policies in step."""
+    out = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    break
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def sparkline(values, width: int = 40) -> str:
+    if not values:
+        return ""
+    vals = values[-width:]
+    lo, hi = min(vals), max(vals)
+    span = max(hi - lo, 1)
+    return "".join(
+        _SPARK[min(int((v - lo) * (len(_SPARK) - 1) / span), len(_SPARK) - 1)]
+        for v in vals
+    )
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def render(records: list, path: str = "") -> str:
+    """One dashboard frame from a campaign's records (pure function —
+    the smoke test renders synthetic histories through here)."""
+    start = next(
+        (r for r in records if r.get("event") == "campaign_start"), {}
+    )
+    gens = [r for r in records if r.get("event") == "generation"]
+    hbs = [r for r in records if r.get("event") == "heartbeat"]
+    compiles = [r for r in records if r.get("event") == "compile"]
+    end = next(
+        (r for r in records if r.get("event") == "campaign_end"), None
+    )
+    summary = next(
+        (r for r in records if r.get("event") == "flight_summary"), None
+    )
+    lines = [
+        f"== campaign_top {path}".rstrip() + " ==",
+        f"workload {start.get('workload', '?')} | driver "
+        f"{start.get('driver', 'host')} | batch {start.get('batch', '?')} "
+        f"| root_seed {start.get('root_seed', '?')} | space "
+        f"{start.get('plan_hash', '?')}",
+    ]
+    target = int(start.get("generations", 0) or 0)
+    done = len(gens)
+    if target:
+        frac = min(done / target, 1.0)
+        bar = "#" * int(frac * 30)
+        state = "DONE" if end else "running"
+        lines.append(
+            f"progress [{bar:<30}] {done}/{target} generations ({state})"
+        )
+    rate = eta = None
+    if hbs:
+        rate = hbs[-1].get("gens_per_s")
+        eta = hbs[-1].get("eta_s")
+    elif gens:
+        wall = sum(
+            sum(float(g.get(k, 0.0)) for k in _WALL_KEYS)
+            + float(g.get("host_wall_s", 0.0))
+            - float(g.get("mutate_wall_s", 0.0))
+            - float(g.get("admit_wall_s", 0.0))
+            for g in gens
+        )
+        rate = done / wall if wall > 0 else None
+        eta = (target - done) / rate if rate and target > done else None
+    if rate:
+        lines.append(
+            f"rate {rate:.3f} gens/s | sims {gens[-1].get('sims', '?') if gens else 0}"
+            + (f" | ETA {eta:.0f}s" if eta else "")
+        )
+    if gens:
+        curve = [g.get("cov_bits", 0) for g in gens]
+        g = gens[-1]
+        lines.append(
+            f"coverage {curve[-1]} bits {sparkline(curve)} | corpus "
+            f"{g.get('corpus_size', '?')} | violations "
+            f"{g.get('violations', '?')}"
+        )
+        walls = [(k.replace("_wall_s", ""), float(g.get(k, 0.0)))
+                 for k in _WALL_KEYS if g.get(k) is not None]
+        total = sum(w for _, w in walls)
+        if total > 0:
+            split = " ".join(
+                f"{name} {w / total:.0%}" for name, w in walls if w > 0
+            )
+            lines.append(f"last gen wall {total:.2f}s: {split}")
+    if hbs and hbs[-1].get("live_buffer_bytes") is not None:
+        hb = hbs[-1]
+        lines.append(
+            f"device memory {_fmt_bytes(hb['live_buffer_bytes'])} across "
+            f"{hb.get('live_buffers', '?')} live buffers"
+            + (f" | allocator {_fmt_bytes(hb['allocator_bytes_in_use'])}"
+               if hb.get("allocator_bytes_in_use") is not None else "")
+        )
+    if compiles:
+        cw = sum(
+            float(c.get("trace_s", 0)) + float(c.get("lower_s", 0))
+            + float(c.get("compile_s", 0))
+            for c in compiles
+        )
+        lines.append(
+            f"compiles {len(compiles)} ({cw:.1f}s total) | last: "
+            f"{compiles[-1].get('program', '?')}"
+        )
+    if summary is not None and summary.get("programs"):
+        lines.append("programs (flight summary):")
+        for p in summary["programs"]:
+            lines.append(
+                f"  {p['name']:<28} traces {p['traces']} calls "
+                f"{p['calls']} compile {p['compile_wall_s']:.2f}s "
+                f"exec {p['execute_wall_s']:.2f}s"
+            )
+    if end is not None:
+        lines.append(
+            f"campaign ended: {end.get('violations', 0)} violations, "
+            f"{end.get('cov_bits', 0)} coverage bits, "
+            f"{end.get('sims', 0)} sims"
+        )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="campaign telemetry JSONL to tail")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    args = ap.parse_args()
+    while True:
+        records = read_records(args.path)
+        frame = render(records, args.path)
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, then the frame (plain ANSI keeps deps at zero)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        if any(r.get("event") == "campaign_end" for r in records):
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,274 @@
+"""Flight-recorder soak: the campaign observability certificates.
+The FLIGHT evidence artifact.
+
+Four certificates:
+
+1. **Retraces == 1 per cache key across a multi-campaign session**
+   (the headline). A ``ProgramProfiler`` session runs THREE
+   ``explore.run_device`` campaigns over the same (workload, config,
+   space, batch) with three different root seeds — the repro-sweep
+   shape of a real hunt session. Historically every campaign rebuilt
+   its generation programs from fresh closures (one trace+lower+
+   compile per campaign, ROADMAP item 1); the generation-program cache
+   (``explore.device._GEN_CACHE``, keyed on workload/config/space/
+   batch/build flags/invariant identity, root seed a runtime argument)
+   must hold that to exactly ONE trace per program key, profiler-
+   certified, with campaigns 2 and 3 reporting compile_wall_s == 0.
+2. **Same-box interleaved cache A/B** — the same campaign run
+   alternately with the cache active (steady state) and with the cache
+   defeated per campaign (fresh workload + invariant identity — the
+   pre-cache behavior). Rounds interleave so box noise hits both
+   sides; the certificate is cached wall < uncached wall with the
+   uncached side paying a fresh compile every campaign.
+3. **Flight-recorder on/off bit-identity** — the same campaign with
+   ``telemetry=None`` and with a full ``FlightRecorder`` (profiler +
+   heartbeats + memory taps armed) must produce identical corpus,
+   coverage map, violation set and curves on BOTH drivers; the flight
+   JSONL must carry the complete wall-split schema
+   (dispatch/compile/sync on the device driver, dispatch/compile/
+   mutate/admit/host on the host driver), monotone heartbeats, and
+   ``host_syncs: 1`` per device generation.
+4. **Campaign Perfetto from a violation-bearing hunt** — a device
+   campaign under a halt invariant (real finds) recorded through the
+   flight recorder, exported with ``obs.campaign_perfetto``:
+   generation spans == generations, coverage/violation counter tracks
+   monotone, compile instants present. The trace JSON is written next
+   to the artifact (open in ui.perfetto.dev).
+
+Usage: python tools/flight_soak.py [batch] [gens] [trace_out]
+           > FLIGHT_r08.txt
+Defaults: batch 4096, gens 4, trace_out FLIGHT_campaign_trace.json.
+Exit 0 iff all four certificates hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore  # noqa: E402
+from madsim_tpu.chaos import (  # noqa: E402
+    CrashStorm,
+    FaultPlan,
+    GrayFailure,
+    PauseStorm,
+)
+from madsim_tpu.engine import EngineConfig  # noqa: E402
+from madsim_tpu.explore import device as _device  # noqa: E402
+from madsim_tpu.models import make_raft  # noqa: E402
+from madsim_tpu.obs import (  # noqa: E402
+    FlightRecorder,
+    campaign_perfetto,
+    write_campaign_perfetto,
+)
+from madsim_tpu.obs import prof  # noqa: E402
+
+NODES = (0, 1, 2, 3, 4)
+CFG = EngineConfig(pool_size=64, loss_p=0.02)
+PLAN = FaultPlan((
+    CrashStorm(targets=(1, 2, 3), n=2, t_min_ns=20_000_000,
+               t_max_ns=400_000_000, down_min_ns=50_000_000,
+               down_max_ns=250_000_000),
+    PauseStorm(targets=NODES, n=1, t_min_ns=20_000_000,
+               t_max_ns=300_000_000, down_min_ns=50_000_000,
+               down_max_ns=200_000_000),
+    GrayFailure(targets=NODES, n_links=1),
+), name="flight-soak")
+MAX_STEPS = 64
+
+DEVICE_WALL_KEYS = ("dispatch_wall_s", "compile_wall_s", "sync_wall_s")
+HOST_WALL_KEYS = ("dispatch_wall_s", "compile_wall_s", "mutate_wall_s",
+                  "admit_wall_s", "host_wall_s")
+
+
+def _cov_inv(view):
+    return view["halted"] | True
+
+
+def _halt_inv(view):
+    return view["halted"]
+
+
+def _fingerprint(rep):
+    return (
+        [(e.id, e.generation, e.parent, e.seed, e.plan.hash(), e.trace,
+          e.new_bits) for e in rep.corpus],
+        rep.cov_map.tolist(),
+        [(e.seed, e.trace) for e in rep.violations],
+        rep.curve,
+        rep.viol_curve,
+    )
+
+
+def main() -> None:
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    gens = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    trace_out = sys.argv[3] if len(sys.argv) > 3 else (
+        "FLIGHT_campaign_trace.json"
+    )
+    failures = []
+    t_all = time.monotonic()  # lint: allow(wall-clock)
+    print(f"# flight soak: batch {batch}, {gens} generations/campaign, "
+          f"platform={jax.devices()[0].platform}")
+    print(f"# plan {PLAN.hash()} ({PLAN.slots} slots), raft, "
+          f"max_steps {MAX_STEPS}")
+
+    wl = make_raft()  # ONE workload object: cache identity, like search
+    kw = dict(generations=gens, batch=batch, max_steps=MAX_STEPS,
+              cov_words=32, invariant=_cov_inv)
+
+    # ---- cert 1: retraces == 1 per key over a 3-campaign session ----
+    print("== cert 1: generation-program retraces across 3 campaigns ==")
+    _device._GEN_CACHE.clear()
+    compile_walls = []
+    with prof.profiled() as p:
+        for i, root in enumerate((7, 8, 9)):
+            t0 = time.monotonic()  # lint: allow(wall-clock)
+            rep = explore.run_device(wl, CFG, PLAN, root_seed=root, **kw)
+            w = time.monotonic() - t0  # lint: allow(wall-clock)
+            compile_walls.append(rep.wall_compile_s)
+            print(f"  campaign {i} (root {root}): {w:6.1f}s wall, "
+                  f"compile {rep.wall_compile_s:6.2f}s, dispatch "
+                  f"{rep.wall_dispatch_s:6.2f}s, {len(rep.corpus)} corpus")
+        retr = p.retraces("explore.device")
+        print("  profiler program table:")
+        for line in p.report().splitlines():
+            print(f"    {line}")
+    ok1 = (
+        retr
+        and all(v == 1 for v in retr.values())
+        and compile_walls[1] == 0.0
+        and compile_walls[2] == 0.0
+    )
+    print(f"  retraces per key: "
+          f"{ {k[0]: v for k, v in retr.items()} } "
+          f"(was: one full rebuild per campaign)")
+    if not ok1:
+        failures.append("retraces")
+    print(f"cert1 {'PASS' if ok1 else 'FAIL'}")
+
+    # ---- cert 2: interleaved cache A/B ----
+    print("== cert 2: same-box interleaved A/B, cache on vs defeated ==")
+    walls = {"cached": [], "uncached": []}
+    for r in range(3):
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        explore.run_device(wl, CFG, PLAN, root_seed=20 + r, **kw)
+        walls["cached"].append(
+            time.monotonic() - t0  # lint: allow(wall-clock)
+        )
+        # defeat the cache the way pre-cache code did implicitly:
+        # fresh workload + fresh invariant identity = new cache key =
+        # full trace+lower+compile for this campaign (the warm entry
+        # for `wl` is untouched, so the next cached round stays warm)
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        explore.run_device(
+            make_raft(), CFG, PLAN, root_seed=20 + r,
+            **{**kw, "invariant": lambda v: v["halted"] | True},
+        )
+        walls["uncached"].append(
+            time.monotonic() - t0  # lint: allow(wall-clock)
+        )
+        print(f"  round {r}: cached {walls['cached'][-1]:6.1f}s | "
+              f"uncached {walls['uncached'][-1]:6.1f}s | ratio "
+              f"{walls['uncached'][-1] / walls['cached'][-1]:.2f}x")
+    med_c = statistics.median(walls["cached"])
+    med_u = statistics.median(walls["uncached"])
+    ratio = med_u / med_c
+    print(f"  medians: cached {med_c:.1f}s vs uncached {med_u:.1f}s -> "
+          f"cache saves {med_u - med_c:.1f}s/campaign ({ratio:.2f}x)")
+    ok2 = ratio > 1.1
+    if not ok2:
+        failures.append("cache-ab")
+    print(f"cert2 {'PASS' if ok2 else 'FAIL'}")
+
+    # ---- cert 3: flight on/off bit-identity + schema ----
+    print("== cert 3: flight-recorder on/off bit-identity (both drivers) ==")
+    vkw = dict(generations=3, batch=min(batch, 4096), root_seed=7,
+               max_steps=96, cov_words=32, invariant=_halt_inv)
+    tmp = tempfile.mkdtemp(prefix="flight_soak_")
+    ok3 = True
+    for tag, runner in (("device", explore.run_device),
+                        ("host", explore.run)):
+        rep_off = runner(wl, CFG, PLAN, **vkw)
+        path = os.path.join(tmp, f"{tag}.jsonl")
+        with FlightRecorder(path, heartbeat_s=0.0) as fr:
+            rep_on = runner(wl, CFG, PLAN, telemetry=fr, **vkw)
+        identical = _fingerprint(rep_off) == _fingerprint(rep_on)
+        recs = [json.loads(line) for line in open(path)]
+        gen_recs = [x for x in recs if x["event"] == "generation"]
+        want = DEVICE_WALL_KEYS if tag == "device" else HOST_WALL_KEYS
+        schema = all(all(k in g for k in want) for g in gen_recs)
+        syncs = (
+            all(g["host_syncs"] == 1 for g in gen_recs)
+            if tag == "device" else True
+        )
+        hbs = [x for x in recs if x["event"] == "heartbeat"]
+        seqs = [x["seq"] for x in recs]
+        monotone = (
+            seqs == sorted(seqs)
+            and [h["generations_done"] for h in hbs]
+            == sorted(h["generations_done"] for h in hbs)
+            and len(hbs) == len(gen_recs)
+        )
+        print(f"  {tag}: identical {identical}, wall-split schema "
+              f"{schema}, host_syncs {syncs}, heartbeats "
+              f"{len(hbs)} monotone {monotone}")
+        ok3 = ok3 and identical and schema and syncs and monotone
+    if not ok3:
+        failures.append("flight-identity")
+    print(f"cert3 {'PASS' if ok3 else 'FAIL'}")
+
+    # ---- cert 4: campaign Perfetto from a violation-bearing hunt ----
+    print("== cert 4: campaign Perfetto (violation-bearing hunt) ==")
+    path = os.path.join(tmp, "hunt.jsonl")
+    _device._GEN_CACHE.clear()  # a cold campaign: compile events real
+    with FlightRecorder(path, heartbeat_s=0.0) as fr:
+        rep = explore.run_device(wl, CFG, PLAN, telemetry=fr, **vkw)
+    doc = write_campaign_perfetto(trace_out, path)
+    spans = [e for e in doc["traceEvents"] if e.get("cat") == "generation"]
+    compiles = [e for e in doc["traceEvents"] if e.get("cat") == "compile"]
+
+    def counter_track(name):
+        return [
+            e["args"][name] for e in doc["traceEvents"]
+            if e.get("ph") == "C" and e.get("name") == name
+        ]
+
+    cov = counter_track("cov_bits")
+    vio = counter_track("violations")
+    ok4 = (
+        len(spans) == vkw["generations"]
+        and len(rep.violations) > 0
+        and cov == sorted(cov)
+        and vio == sorted(vio)
+        and len(compiles) >= 1
+        and campaign_perfetto(path)["otherData"]["generations"]
+        == vkw["generations"]
+    )
+    print(f"  {len(spans)} generation spans == {vkw['generations']} "
+          f"generations, {len(rep.violations)} violations, cov track "
+          f"{cov} monotone, violation track {vio} monotone, "
+          f"{len(compiles)} compile instant(s)")
+    print(f"  trace written to {trace_out} "
+          f"({len(doc['traceEvents'])} events — open in ui.perfetto.dev)")
+    if not ok4:
+        failures.append("campaign-perfetto")
+    print(f"cert4 {'PASS' if ok4 else 'FAIL'}")
+
+    print(f"# total {time.monotonic() - t_all:.1f}s | "  # lint: allow(wall-clock)
+          f"{'ALL PASS' if not failures else 'FAIL: ' + ','.join(failures)}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,9 +12,12 @@ Four certificates:
    where eligible), traced via the single-seed step AND the vmapped
    ``make_run`` scan path, plus the sharded-campaign row (every model
    under the campaign tap set, proved through the ``shard_map`` call
-   boundary — the program shape ``explore.run_device`` dispatches):
-   every derived column provably isolated from every core column and
-   the trace fold.
+   boundary — the program shape ``explore.run_device`` dispatches),
+   plus the flight-recorder boundary row (the same campaign program
+   traced with an ``obs.prof.ProgramProfiler`` active: no
+   host-callback primitive, taint unchanged — the flight taps are
+   provably host-side): every derived column provably isolated from
+   every core column and the trace fold.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
    value-identical op reading a metrics counter into the RNG cursor)
    is caught, with the offending equation chain and the column names.
@@ -46,6 +49,7 @@ from madsim_tpu.lint import (  # noqa: E402
 from madsim_tpu.lint.noninterference import (  # noqa: E402
     BUILD_AXES,
     CAMPAIGN_AXES,
+    FLIGHT_AXES,
     LAYOUT_AXES,
 )
 from madsim_tpu.engine import EngineConfig  # noqa: E402
@@ -79,6 +83,16 @@ def main() -> None:
         log=lambda s: print(f"  {s}"),
     )
     bad += [r for r in sharded_reports if not r.ok]
+    # the flight-recorder boundary row: the campaign tap set traced
+    # with an obs.prof.ProgramProfiler ACTIVE through the shard_map
+    # boundary — the profiler/heartbeat/memory taps are host-side by
+    # design, and this proves the traced program stays callback-free
+    # and taint-isolated with them armed
+    flight_reports = check_matrix(
+        axes=FLIGHT_AXES, entry="sharded_run",
+        log=lambda s: print(f"  {s}"),
+    )
+    bad += [r for r in flight_reports if not r.ok]
     if bad:
         failures.append("noninterference")
         for r in bad:

@@ -26,12 +26,12 @@ SHELL   := /bin/bash
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
         store-soak latency-soak lint lint-soak profile clean \
-        campaign-bench flight
+        campaign-bench flight pool-bench pool-bench-smoke
 
-check: native lint test determinism bench-smoke flight
+check: native lint test determinism bench-smoke flight pool-bench-smoke
 	@echo "== make check: all gates passed =="
 
-check-full: native lint test-full determinism bench-smoke flight
+check-full: native lint test-full determinism bench-smoke flight pool-bench-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -47,15 +47,34 @@ lint-soak:
 
 # Per-config step profile (tools/profile_step.py): phase wall
 # breakdown by ablation differencing + XLA's HLO cost analysis, one
-# JSONL row per bench config — the attribution evidence behind any
-# perf claim (replaces the hand-run PROFILE_CPU_r05 flow). Pure
-# measurement, never part of tier-1. PROFILE_OUT / PROFILE_CONFIGS
-# override the artifact name and the config list.
-PROFILE_OUT     ?= PROFILE_CPU_r06.jsonl
-PROFILE_CONFIGS ?=
+# JSONL row per bench config, PLUS the ISSUE-13 pool-size sweep axis
+# (512/2048/8192, army on/off, flat vs readiness-indexed) attributing
+# pop-argmin vs placement vs handler wall — the attribution evidence
+# behind any perf claim. Pure measurement, never part of tier-1.
+# PROFILE_OUT / PROFILE_CONFIGS override the artifact name and the
+# arguments (config names and/or --pool-sweep; the default regenerates
+# the round-9 pool-sweep artifact — pass "raftlog kvchaos raft" for
+# the per-config phase rows).
+PROFILE_OUT     ?= PROFILE_CPU_r07.jsonl
+PROFILE_CONFIGS ?= --pool-sweep
 profile:
 	$(PY) tools/profile_step.py $(PROFILE_CONFIGS) > $(PROFILE_OUT)
 	@cat $(PROFILE_OUT)
+
+# Readiness-partitioned pool A/B (tools/pool_bench.py, ISSUE 13):
+# same-box interleaved flat-vs-indexed bench on the army configs at
+# pool_size >= 2048 — bit-identical final states asserted (traces,
+# histories, latency sketches; identity over the full state implies
+# identical violations for any invariant) and the >= 2x throughput
+# acceptance floor enforced. The BENCH_AB_r07.txt evidence artifact.
+# The smoke (one config, small batch, identity + measured speedup, no
+# floor) rides `make check`.
+pool-bench:
+	$(PY) tools/pool_bench.py > BENCH_AB_r07.txt; rc=$$?; \
+	    cat BENCH_AB_r07.txt; exit $$rc
+
+pool-bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/pool_bench.py --smoke
 
 native:
 	$(MAKE) -C native

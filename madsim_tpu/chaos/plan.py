@@ -988,6 +988,31 @@ class FaultPlan(_PlanBase):
         spec tuple fully determines every compiled trajectory."""
         return hashlib.sha256(repr(self.specs).encode()).hexdigest()[:16]
 
+    def min_pool_size(self, wl, headroom: int = 16, tile_align: bool = True) -> int:
+        """Smallest ``EngineConfig.pool_size`` this plan's pre-seeded
+        rows fit into: one on_init row per node + every plan slot +
+        ``headroom`` for in-flight protocol traffic per pending op.
+
+        ``tile_align=True`` (default) rounds up to the next readiness-
+        index tile multiple (``engine.pool_tile``), so an army-scale
+        pool sized through here is never locked OUT of the O(ready)
+        indexed pop by a missing tile divisor — client armies are
+        exactly the pools where the flat O(E) scan hurts (ROADMAP
+        items 2/4). The index still engages only past the measured
+        auto threshold (pools > 1024 slots; below it the flat lowering
+        is the faster program — pass ``pool_index=True`` explicitly to
+        override). Headroom is a floor, not a proof: run the sweep
+        once and check ``overflow == 0`` (the bench rule) before
+        trusting a sizing.
+        """
+        base = wl.n_nodes + self.slots + max(int(headroom), 0)
+        if not tile_align:
+            return base
+        from ..engine.core import POOL_TILE_CANDIDATES
+
+        tile = POOL_TILE_CANDIDATES[0]
+        return ((base + tile - 1) // tile) * tile
+
     def validate_windows(self, time_limit_ns: int, warn: bool = True):
         """Specs whose fire window opens at-or-after ``time_limit_ns``.
 

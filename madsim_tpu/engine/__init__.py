@@ -19,6 +19,8 @@ jax.config.update("jax_enable_x64", True)
 
 from .core import (  # noqa: E402,F401
     DERIVED_STATE_FIELDS,
+    POOL_INDEX_STATE_FIELDS,
+    POOL_TILE_CANDIDATES,
     STORAGE_STATE_FIELDS,
     FIRST_EXT_KIND,
     FIRST_USER_KIND,
@@ -64,8 +66,12 @@ from .core import (  # noqa: E402,F401
     lat_bucket,
     lat_bucket_hi,
     lat_bucket_lo,
+    build_pool_index,
     core_fields,
     derived_fields,
+    pool_index_eligible,
+    pool_tile,
+    resolve_rank_place_max_pool,
     make_init,
     make_run,
     make_run_while,

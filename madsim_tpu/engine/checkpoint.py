@@ -22,7 +22,14 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .core import EngineConfig, SimState
+from .core import (
+    POOL_INDEX_STATE_FIELDS,
+    EngineConfig,
+    SimState,
+    _resolve_pool_index,
+    build_pool_index,
+    pool_tile,
+)
 
 __all__ = ["save", "load"]
 
@@ -39,7 +46,15 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # columns (lat_inv/lat_resp/lat_hist/lat_count/lat_drop) and the
 # emit-time sidecar (ev_emit/tl_emit, madsim_tpu.obs latency). Older
 # checkpoints are rejected with the designed mismatch error rather
-# than a KeyError mid-load
+# than a KeyError mid-load.
+#
+# The readiness-index tile summaries (POOL_INDEX_STATE_FIELDS, ISSUE
+# 13) are NOT part of the format: they are derived by construction
+# (a pure function of ev_time/ev_valid — engine.build_pool_index is
+# the definition), so save() skips them and load() rebuilds them for
+# whatever pool_index resolution the resumed run uses. Format 9 is
+# unchanged — old checkpoints load into indexed runs and new
+# checkpoints load under old readers byte-for-byte.
 _FORMAT = 9
 
 
@@ -48,6 +63,7 @@ def save(path: str, state: SimState, cfg: EngineConfig) -> None:
     arrays = {
         f.name: np.asarray(getattr(state, f.name))
         for f in dataclasses.fields(state)
+        if f.name not in POOL_INDEX_STATE_FIELDS  # derived: rebuilt on load
     }
     # ev_time dtype records the time representation (int32 = time32
     # offset form, int64 = absolute): time32 auto-resolution depends on
@@ -67,7 +83,12 @@ def save(path: str, state: SimState, cfg: EngineConfig) -> None:
         np.savez(fh, **arrays)
 
 
-def load(path: str, cfg: EngineConfig, time32: bool | None = None) -> SimState:
+def load(
+    path: str,
+    cfg: EngineConfig,
+    time32: bool | None = None,
+    pool_index: bool | None = None,
+) -> SimState:
     """Load a SimState; refuses a checkpoint taken under another config.
 
     ``time32``: the representation the resumed run will use (what you
@@ -77,6 +98,13 @@ def load(path: str, cfg: EngineConfig, time32: bool | None = None) -> SimState:
     silently mismatch the builder on another; passing it here turns the
     later step-time dtype TypeError into an immediate, explained error.
     None skips the check (the manifest still records the saved dtype).
+
+    ``pool_index``: whether the resumed run carries the readiness-index
+    tile summaries (pass the same value you pass the run builders;
+    None = the same auto rule). The summaries are never read from the
+    file — they are REBUILT here from the loaded pool columns
+    (``engine.build_pool_index``), which is what makes them derived
+    state: the checkpoint format carries only ground truth.
     """
     with np.load(path) as data:
         manifest = json.loads(bytes(data[_MANIFEST_KEY]).decode())
@@ -89,8 +117,18 @@ def load(path: str, cfg: EngineConfig, time32: bool | None = None) -> SimState:
                 "silently change the simulation trajectory"
             )
         fields = {
-            f.name: jnp.asarray(data[f.name]) for f in dataclasses.fields(SimState)
+            f.name: jnp.asarray(data[f.name])
+            for f in dataclasses.fields(SimState)
+            if f.name not in POOL_INDEX_STATE_FIELDS
         }
+    if _resolve_pool_index(cfg, pool_index):
+        fields["tile_min"], fields["tile_cnt"] = build_pool_index(
+            fields["ev_time"], fields["ev_valid"], pool_tile(cfg.pool_size)
+        )
+    else:
+        s = fields["ev_valid"].shape[:-1] + (0,)
+        fields["tile_min"] = jnp.zeros(s, fields["ev_time"].dtype)
+        fields["tile_cnt"] = jnp.zeros(s, jnp.int32)
     state = SimState(**fields)
     saved_dt = manifest.get("ev_time_dtype", str(np.asarray(state.ev_time).dtype))
     if time32 is not None:

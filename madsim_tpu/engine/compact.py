@@ -127,6 +127,8 @@ def make_run_compacted(
     cov_hitcount: bool = False,
     latency=None,
     placement: str | None = None,
+    pool_index: bool | None = None,
+    rank_place_max_pool: int | None = None,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -143,6 +145,7 @@ def make_run_compacted(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
+        pool_index, rank_place_max_pool,
     ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:

@@ -52,6 +52,7 @@ replay checker, runtime/mod.rs:165-190).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable
 
 import numpy as np
@@ -125,8 +126,14 @@ __all__ = [
     "time32_eligible",
     "DERIVED_STATE_FIELDS",
     "STORAGE_STATE_FIELDS",
+    "POOL_INDEX_STATE_FIELDS",
     "derived_fields",
     "core_fields",
+    "pool_tile",
+    "pool_index_eligible",
+    "resolve_layout",
+    "build_pool_index",
+    "resolve_rank_place_max_pool",
 ]
 
 _INF_NS = np.int64(2**62)
@@ -139,8 +146,178 @@ _T32_LIMIT = 2**31 - 1  # max future-event offset representable in int32
 # seed per 64 slots on CPU); scatter-store placement costs one serial
 # row-update per emit slot (~110 ns per row on XLA CPU, independent of
 # E). Measured crossover sits near E ≈ 1k; 512 keeps headroom for wider
-# emit rows (tools/profile_step.py re-measures it per config).
+# emit rows (tools/profile_step.py re-measures it per config). This is
+# the DEFAULT of the documented ``make_step(rank_place_max_pool=)``
+# knob; the env var below overrides the default without touching call
+# sites (a deployment knob — program-shaping, so callers that CACHE
+# compiled runs must key on the resolved value: engine.search's
+# _RUN_CACHE folds resolve_rank_place_max_pool() into its key).
 _RANK_PLACE_MAX_POOL = 512
+_RANK_PLACE_ENV = "MADSIM_RANK_PLACE_MAX_POOL"
+
+
+def _env_int(name: str, default: int) -> int:
+    """A validated non-negative int env override (a deployment typo
+    must name the variable, not crash as a bare int() error or pass
+    through as silent nonsense)."""
+    env = os.environ.get(name)
+    if not env:
+        return default
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not an integer") from None
+    if val < 0:
+        raise ValueError(f"{name}={env!r} must be >= 0")
+    return val
+
+
+def resolve_rank_place_max_pool(override: int | None = None) -> int:
+    """Resolve the rank-placement pool-size crossover (the ``placement``
+    default in :func:`make_step`): explicit ``override`` beats the
+    ``MADSIM_RANK_PLACE_MAX_POOL`` env var beats the measured module
+    default (512). Pinned by tests/test_pool_index.py."""
+    if override is not None:
+        if override < 0:
+            raise ValueError(
+                f"rank_place_max_pool must be >= 0, got {override}"
+            )
+        return int(override)
+    return _env_int(_RANK_PLACE_ENV, _RANK_PLACE_MAX_POOL)
+
+
+# ---------------------------------------------------------------------------
+# Readiness-partitioned pool index (make_step's ``pool_index``). The E
+# pool slots split into fixed tiles of T rows; SimState carries per-tile
+# summary columns (tile_min = earliest VALID time in the tile, tile_cnt
+# = number of valid slots), maintained incrementally by the step. The
+# pop becomes argmin over E/T tile minima + argmin inside the ONE
+# winning tile, and free-slot search for placement becomes a cumsum
+# over per-tile free counts + a rank match inside the target tiles —
+# O(E/T + T + emits) per event instead of the flat layout's O(E)
+# masked-min + flatnonzero passes, which dominate the step at
+# client-army pool sizes (thousands of slots, see ISSUE 13 /
+# PROFILE_CPU_r07). Values are identical by construction: argmin over
+# tile minima followed by argmin inside the winning tile picks exactly
+# the global first-minimum slot, and the rank-matched free search
+# reproduces flatnonzero's slot order bit-for-bit.
+#
+# The summaries are DERIVED BY CONSTRUCTION — a pure function of
+# (ev_time, ev_valid), rebuilt on checkpoint restore (engine/checkpoint
+# excludes them from the format; _FORMAT is unchanged) — but they are
+# trajectory-COUPLED: the pop reads them. So they live in core_fields
+# for the static taint proof (derived obs columns must stay out of
+# them, which lint.check_matrix proves over the indexed program), and
+# their own correctness certificate is the index on/off bit-identity
+# pin (tests/test_pool_index.py, tests/test_stepident.py goldens).
+# ---------------------------------------------------------------------------
+# candidate tile widths, preferred first. T ~ sqrt(E) is the pop
+# optimum; 64 serves every army-scale pool (2048 -> 32 tiles, 8192 ->
+# 128), the smaller widths let small test pools (40/48/64/72/96) run
+# the indexed program for identity pins.
+POOL_TILE_CANDIDATES = (64, 32, 16, 8)
+# auto-resolution threshold: pool_index=None turns the index on (CPU
+# backend, scatter layout) for pools STRICTLY larger than this. 1024
+# keeps every measured small-pool config (<= 512, BENCH_SPECS) on
+# today's lowering — the interleaved A/B (BENCH_AB_r07.txt) measured
+# the crossover between 1024 and 2048 on CPU.
+_POOL_INDEX_MIN_POOL = 1024
+_POOL_INDEX_ENV = "MADSIM_POOL_INDEX_MIN_POOL"
+
+
+def _pool_index_min_pool() -> int:
+    return _env_int(_POOL_INDEX_ENV, _POOL_INDEX_MIN_POOL)
+
+
+def pool_tile(pool_size: int) -> int:
+    """Tile width the readiness index would use for this pool size
+    (the largest :data:`POOL_TILE_CANDIDATES` divisor leaving >= 2
+    tiles), or 0 when no candidate divides it — the pool is then not
+    index-eligible and ``pool_index=True`` is rejected."""
+    for t in POOL_TILE_CANDIDATES:
+        if pool_size % t == 0 and pool_size // t >= 2:
+            return t
+    return 0
+
+
+def pool_index_eligible(cfg: "EngineConfig") -> bool:
+    """Whether this config's pool can carry the readiness index."""
+    return pool_tile(cfg.pool_size) > 0
+
+
+def resolve_layout(layout: str | None) -> str:
+    """THE layout default (make_step's ``layout=None`` rule): scatter
+    on the CPU backend, dense elsewhere. Shared with every caller that
+    must pre-resolve a build flag against the layout a run will
+    actually compile (engine.search resolves ``pool_index`` through
+    it), so the rule cannot silently fork."""
+    if layout is None:
+        return "scatter" if jax.default_backend() == "cpu" else "dense"
+    if layout not in ("dense", "scatter"):
+        raise ValueError(f"unknown layout {layout!r}")
+    return layout
+
+
+def _resolve_pool_index(
+    cfg: "EngineConfig", pool_index: bool | None, dense: bool | None = None
+) -> bool:
+    """Shared by make_init (dense=None: the backend rule, mirroring the
+    layout default) and make_step (dense = the resolved layout). Auto
+    (None) turns the index on only where it wins: the scatter layout's
+    large pools ON THE CPU BACKEND — the backend conjunct keeps a
+    forced ``layout="scatter"`` on an accelerator consistent with
+    make_init's layout-blind resolution (a mismatch the other way —
+    CPU init auto-on + a forced dense step — is absorbed by the
+    off-step's index-preserving rebuild, see make_step). Explicit True
+    on an ineligible pool or under the dense layout is an error, never
+    a silent fallback."""
+    tile = pool_tile(cfg.pool_size)
+    if pool_index is None:
+        if dense is None:
+            dense = jax.default_backend() != "cpu"
+        return (
+            bool(tile)
+            and not dense
+            and jax.default_backend() == "cpu"
+            and cfg.pool_size > _pool_index_min_pool()
+        )
+    if pool_index:
+        if not tile:
+            raise ValueError(
+                f"pool_index requested but pool_size={cfg.pool_size} has "
+                f"no tile divisor in {POOL_TILE_CANDIDATES} with >= 2 "
+                f"tiles; round the pool up (chaos.FaultPlan.min_pool_size "
+                f"sizes army pools tile-aligned)"
+            )
+        if dense:
+            raise ValueError(
+                "pool_index is a scatter-layout lowering; the dense "
+                "layout's one-hot pop has no tile index — pass "
+                "pool_index=False (or leave it None) with layout='dense'"
+            )
+    return bool(pool_index)
+
+
+def build_pool_index(ev_time, ev_valid, tile: int):
+    """Compute ``(tile_min, tile_cnt)`` summaries from pool columns.
+
+    Pure function of the pool — THE definition the step maintains
+    incrementally and checkpoint restore / tests rebuild from scratch.
+    Works on one seed's ``(E,)`` columns or a batch's ``(S, E)``
+    (any leading axes; the pool axis is last). ``tile_min`` of an empty
+    tile is the +inf sentinel of the time dtype; every consumer masks
+    by ``tile_cnt > 0`` (stale minima of empty tiles are meaningless,
+    exactly like stale times of invalid pool slots)."""
+    v = jnp.asarray(ev_valid)
+    t = jnp.asarray(ev_time)
+    e = v.shape[-1]
+    if tile <= 0 or e % tile:
+        raise ValueError(f"tile={tile} does not partition pool_size={e}")
+    shape = v.shape[:-1] + (e // tile, tile)
+    inf = jnp.asarray(_INF_32 if t.dtype == jnp.int32 else _INF_NS, t.dtype)
+    tile_min = jnp.min(jnp.where(v.reshape(shape), t.reshape(shape), inf), axis=-1)
+    tile_cnt = jnp.sum(v.reshape(shape).astype(jnp.int32), axis=-1)
+    return tile_min, tile_cnt
 
 # ---------------------------------------------------------------------------
 # ev_meta byte layout. The four small per-event fields travel as one
@@ -416,6 +593,16 @@ DERIVED_STATE_FIELDS = (
 # Workload.durable_sync is off, CORE when it is on — a crash then reads
 # the disk image back into node_state, a legitimate feedback path.
 STORAGE_STATE_FIELDS = ("disk", "wmask", "sync_loss", "sync_eio", "torn")
+
+# the readiness-index tile summaries (see the pool-index note above):
+# derived BY CONSTRUCTION — a pure function of (ev_time, ev_valid),
+# rebuilt on checkpoint restore, excluded from the checkpoint format —
+# but trajectory-coupled (the pop reads them), so they are NOT in the
+# taint-source set: the static proof treats them as core columns (obs
+# state must never reach them) and their value-correctness certificate
+# is the index on/off bit-identity pin. Zero-size when the index is
+# off, the usual discipline.
+POOL_INDEX_STATE_FIELDS = ("tile_min", "tile_cnt")
 
 
 def derived_fields(wl: "Workload") -> tuple:
@@ -1170,6 +1357,16 @@ class SimState:
     lat_hist: jnp.ndarray  # (P, B) int32 latency sketch
     lat_count: jnp.ndarray  # () int32 completed ops folded into the sketch
     lat_drop: jnp.ndarray  # () int32 markers with out-of-range op ids (loud)
+    # readiness-partitioned pool index (make_step's ``pool_index``; NT =
+    # pool_size/tile when on, else 0 — zero-size, zero cost, the usual
+    # off discipline). Derived by construction from (ev_time, ev_valid)
+    # — build_pool_index is the definition, checkpoint restore rebuilds
+    # them, the format is unchanged — but trajectory-coupled: the pop
+    # reads them, so they sit in core_fields for the taint proof (see
+    # POOL_INDEX_STATE_FIELDS). tile_min of an empty tile is stale
+    # (masked by tile_cnt > 0 at every use, the invalid-slot rule).
+    tile_min: jnp.ndarray  # (NT,) pool-time dtype: earliest valid time/tile
+    tile_cnt: jnp.ndarray  # (NT,) int32: valid slots per tile
 
     @property
     def sim_seconds(self):
@@ -1284,6 +1481,7 @@ def make_init(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    pool_index: bool | None = None,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -1304,6 +1502,13 @@ def make_init(
     observability columns (madsim_tpu.obs; see the make_step docstring);
     each must match the step builder's value, and each defaults to off
     (zero-size arrays, zero cost, bit-identical values).
+
+    ``pool_index`` sizes the readiness-index tile summaries (see the
+    make_step docstring) and must match the step builder's value; both
+    default to the same automatic rule (on for CPU scatter pools larger
+    than the crossover threshold), so callers normally pass neither —
+    but a caller forcing a non-default ``layout`` on an accelerator
+    should pass it explicitly to both, exactly like ``time32``.
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     p = plan_slots
@@ -1325,6 +1530,9 @@ def make_init(
     # sync discipline: a fresh node's disk holds the initial image (the
     # durable columns of init_state are what a cold start reads back)
     d = n if wl.durable_sync else 0
+    # readiness index: tile width + count (0 tiles = index off)
+    p_tile = pool_tile(e) if _resolve_pool_index(cfg, pool_index) else 0
+    n_tiles = e // p_tile if p_tile else 0
 
     def init_one(seed, pt=None, pk=None, pa=None, pv=None, pn=None) -> SimState:
         seed = jnp.asarray(seed, jnp.uint64)
@@ -1370,6 +1578,11 @@ def make_init(
             jnp.zeros((e,), jnp.int32),
             jnp.zeros((e,), jnp.int32),
         )
+        if n_tiles:
+            tile_min, tile_cnt = build_pool_index(ev_time, ev_valid, p_tile)
+        else:
+            tile_min = jnp.zeros((0,), tdtype)
+            tile_cnt = jnp.zeros((0,), jnp.int32)
         return SimState(
             seed=seed,
             now=jnp.int64(0),
@@ -1421,6 +1634,8 @@ def make_init(
             lat_hist=jnp.zeros((lat_p, N_LAT_BUCKETS if lat_c else 0), jnp.int32),
             lat_count=jnp.int32(0),
             lat_drop=jnp.int32(0),
+            tile_min=tile_min,
+            tile_cnt=tile_cnt,
         )
 
     def init(seeds, plan: PlanRows | None = None) -> SimState:
@@ -1506,6 +1721,9 @@ def make_step(
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
     placement: str | None = None,
+    pool_index: bool | None = None,
+    rank_place_max_pool: int | None = None,
+    _lat_export: bool = False,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
 
@@ -1542,7 +1760,30 @@ def make_step(
       the pool is large (client-army pools, thousands of slots) and
       the O(E) vector passes dominate instead.
     * ``None`` (default) — ``"rank"`` when ``cfg.pool_size`` <=
-      ``_RANK_PLACE_MAX_POOL`` (512), else ``"scatter"``.
+      :func:`resolve_rank_place_max_pool` (the documented crossover knob:
+      ``rank_place_max_pool=`` here, the ``MADSIM_RANK_PLACE_MAX_POOL``
+      env var, or the measured module default 512) and the readiness
+      index is off, else ``"scatter"``. Under ``pool_index`` the
+      default is ``"scatter"``: placement writes are then O(emits)
+      element stores whatever the pool size, and the measured CPU
+      crossover favors them over the within-tile select chains
+      (``"rank"`` under the index) — see SCALING.md round 9.
+
+    ``pool_index`` adds the two-level readiness index to the pool (the
+    ISSUE-13 tentpole): per-tile ``tile_min``/``tile_cnt`` summary
+    columns ride SimState (derived by construction, rebuilt on
+    checkpoint restore, format unchanged), the pop runs argmin over
+    E/T tile minima then argmin inside the ONE winning tile, and
+    placement's free-slot search runs over per-tile free counts plus a
+    rank match inside the target tiles — O(E/T + T + emits) per event
+    instead of O(E), value-identical by construction (the goldens pin
+    it). ``None`` (default) resolves on for CPU scatter pools larger
+    than 1024 slots (``MADSIM_POOL_INDEX_MIN_POOL`` overrides the
+    threshold), off otherwise — small-pool programs are exactly
+    today's lowering. Requires a tile-divisible pool
+    (:func:`pool_tile`; ``chaos.FaultPlan.min_pool_size`` sizes army
+    pools aligned) and the scatter layout. Must match the ``init``
+    builder's value, like ``time32``.
 
     ``time32`` picks the *representation* of pool event times — again
     value-identical (tests/test_engine.py asserts it):
@@ -1635,22 +1876,45 @@ def make_step(
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
     _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
-    if layout is None:
-        layout = "scatter" if jax.default_backend() == "cpu" else "dense"
-    if layout not in ("dense", "scatter"):
-        raise ValueError(f"unknown layout {layout!r}")
+    layout = resolve_layout(layout)
     dense = layout == "dense"
+    pool_index = _resolve_pool_index(cfg, pool_index, dense=dense)
+    p_tile = pool_tile(cfg.pool_size) if pool_index else 0
+    n_tiles = cfg.pool_size // p_tile if p_tile else 0
     if placement is None:
         placement = (
-            "rank" if cfg.pool_size <= _RANK_PLACE_MAX_POOL else "scatter"
+            "rank"
+            if (not pool_index)
+            and cfg.pool_size <= resolve_rank_place_max_pool(rank_place_max_pool)
+            else "scatter"
         )
     if placement not in ("rank", "scatter"):
         raise ValueError(f"unknown placement {placement!r}")
     # rank-matched pool writes (scatter layout only; dense has its own
     # one-hot placement). Single-row appends (timeline ring, latency
     # clocks) stay .at[] stores either way — one serial row per step is
-    # exactly the O(1) write a cold-bank append wants.
+    # exactly the O(1) write a cold-bank append wants. Under the
+    # readiness index, "rank" means the within-tile select-chain
+    # variant: the full-pool passes never run.
     rank_place = (not dense) and placement == "rank"
+    if _lat_export:
+        # cold/hot split (make_run's cold_split): the step EXPORTS the
+        # raw latency markers instead of folding them — the (C,)-wide
+        # per-op columns pass through untouched and the run builder
+        # applies them batch-level under a lax.cond, so the cold bank
+        # is read/written only on steps where some seed marked an op.
+        if latency is None or ll == 0:
+            raise ValueError(
+                "_lat_export needs the latency tap (a LatencySpec and "
+                "Workload.lat_markers > 0) — there is nothing to export"
+            )
+        if cov_words:
+            raise ValueError(
+                "cold_split folds latency markers outside the step, so "
+                "the (window, latency-bucket) coverage features cannot "
+                "be computed in-step: use cov_words=0 (bench/obs runs) "
+                "or the in-step latency tap (hunt runs)"
+            )
     time32 = _resolve_time32(wl, cfg, time32)
     t_inf = _INF_32 if time32 else _INF_NS
 
@@ -1758,6 +2022,20 @@ def make_step(
                 f"{jnp.dtype(expected_t).name}); build init/step with "
                 f"matching explicit time32= values"
             )
+        # pool-index shape guard (the same trace-time rule): an INDEXED
+        # step popping a state without matching summaries would be
+        # silently wrong, so it demands the exact tile count. The
+        # off-step accepts anything — it rebuilds whatever summaries
+        # the state carries (index-preserving, below), so a flat build
+        # can always consume an indexed state.
+        if pool_index and st.tile_cnt.shape[0] != n_tiles:
+            raise TypeError(
+                f"SimState carries {st.tile_cnt.shape[0]} pool-index "
+                f"tiles but this step was built with {n_tiles}; build "
+                f"init/step with matching explicit pool_index= values "
+                f"(auto-resolution is backend-dependent, the time32 "
+                f"rule)"
+            )
         # ---- pop the earliest pending event (the timer-jump of
         # time/mod.rs:45-60 merged with the ready-queue drain) ----
         # Two value-identical lowerings of every per-event read/write
@@ -1767,8 +2045,26 @@ def make_step(
         # TPU, examples/profile_step.py); scatter = plain indexing with
         # in_range masks so OOB handling matches dense and the oracle.
         e_slots = st.ev_valid.shape[0]
-        tmask = jnp.where(st.ev_valid, st.ev_time, t_inf)
-        i = jnp.argmin(tmask)
+        if pool_index:
+            # two-level pop: argmin over the E/T carried tile minima
+            # (empty tiles masked to +inf — their stale minima never
+            # compete), then argmin inside the ONE winning tile (a
+            # single T-wide row gather from the reshaped pool). The
+            # first tile achieving the global minimum contains the
+            # globally first minimal slot, and argmin's first-match
+            # tie-break inside it picks exactly that slot — identical
+            # to the flat argmin over all E, at O(E/T + T).
+            tmin = jnp.where(st.tile_cnt > 0, st.tile_min, t_inf)
+            wtile = jnp.argmin(tmin).astype(jnp.int32)
+            tv_row = st.ev_valid.reshape(n_tiles, p_tile)[wtile]
+            tt_row = st.ev_time.reshape(n_tiles, p_tile)[wtile]
+            li = jnp.argmin(
+                jnp.where(tv_row, tt_row, t_inf)
+            ).astype(jnp.int32)
+            i = wtile * p_tile + li
+        else:
+            tmask = jnp.where(st.ev_valid, st.ev_time, t_inf)
+            i = jnp.argmin(tmask)
         slot_ids = jnp.arange(e_slots, dtype=jnp.int32)
         is_popped = slot_ids == i.astype(jnp.int32)
 
@@ -1785,7 +2081,12 @@ def make_step(
             def pick_slot(arr):
                 return arr[i]
 
-        has_event = jnp.any(st.ev_valid & is_popped)
+        if pool_index:
+            # == ev_valid[i], read from the already-gathered tile row
+            # instead of an O(E) masked any
+            has_event = tv_row[li]
+        else:
+            has_event = jnp.any(st.ev_valid & is_popped)
         ev_time_i = pick_slot(st.ev_time)
         if time32:
             # offsets are relative to st.now; a (slightly) negative
@@ -1944,7 +2245,7 @@ def make_step(
         meta_bumped = (meta_i & jnp.uint32(0x00FFFFFF)) | (
             jnp.minimum(retries + 1, 255).astype(jnp.uint32) << jnp.uint32(24)
         )
-        if dense or rank_place:
+        if dense or (rank_place and not pool_index):
             # masked selects: the popped slot is consumed (or its
             # backoff rescheduled) by a fused vector pass — identical
             # values to the .at[i] store, no serial scatter
@@ -1952,12 +2253,26 @@ def make_step(
             ev_time_mid = jnp.where(is_popped & resched, back_t, ev_time_reb)
             ev_meta_mid = jnp.where(is_popped & resched, meta_bumped, st.ev_meta)
         else:
+            # O(1) element stores (under the readiness index too: one
+            # serial row beats a full-pool select pass at army scale)
             ev_valid_mid = st.ev_valid.at[i].set(resched)
             ev_time_mid = ev_time_reb.at[i].set(
                 jnp.where(resched, back_t, old_t)
             )
             ev_meta_mid = st.ev_meta.at[i].set(
                 jnp.where(resched, meta_bumped, meta_i)
+            )
+        if pool_index:
+            # index maintenance, part 1: rebase the carried minima with
+            # the pool (time32 offsets shrink by the clock advance;
+            # empty tiles' stale values may wrap — masked at every
+            # use), and account the popped slot's consume/reschedule
+            # into its tile's count. The popped tile's MIN is
+            # recomputed exactly after placement (a consume can RAISE
+            # it, which no incremental min update can express).
+            tile_min_mid = (st.tile_min - adv32) if time32 else st.tile_min
+            tile_cnt_mid = st.tile_cnt.at[wtile].add(
+                resched.astype(jnp.int32) - has_event.astype(jnp.int32)
             )
 
         # ---- dispatch: user handlers via lax.switch; engine kinds are
@@ -2346,7 +2661,7 @@ def make_step(
                 )
             else:
                 ev_emit = st.ev_emit
-        elif rank_place:
+        elif rank_place and not pool_index:
             # rank-matched vector placement: the free slots are the
             # ready-to-receive partition of the pool, ranked in slot
             # order by one cumsum; the j-th valid emit pairs with the
@@ -2403,24 +2718,175 @@ def make_step(
             else:
                 ev_emit = st.ev_emit
         else:
-            free = jnp.flatnonzero(~ev_valid_mid, size=k1, fill_value=e_slots)
-            slot = jnp.where(
-                e_valid, free[jnp.clip(pos, 0, k1 - 1)], jnp.int32(e_slots)
-            )
-            dropped = e_valid & (slot >= e_slots)
-            overflow = st.overflow + jnp.sum(dropped).astype(jnp.int32) + n_delay_over
-            ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
-            ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
-            ev_meta = ev_meta_mid.at[slot].set(e_meta, mode="drop")
-            ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
-            ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
-            ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
-            if timeline_cap:
-                ev_emit = st.ev_emit.at[slot].set(
-                    jnp.broadcast_to(now, (k1,)), mode="drop"
+            if pool_index:
+                # readiness-index free search, O(E/T + T + emits): the
+                # j-th valid emit still takes the j-th free slot in
+                # pool order (the flatnonzero contract, bit-for-bit) —
+                # but the rank is resolved through the carried per-tile
+                # counts: a cumsum over E/T free counts locates each
+                # emit's target TILE (searchsorted over the exclusive
+                # ranks), and one (k1, T) row gather + rank match finds
+                # the slot inside it. No O(E) flatnonzero pass.
+                free_tiles = jnp.int32(p_tile) - tile_cnt_mid
+                cum_incl = jnp.cumsum(free_tiles)
+                n_free = cum_incl[n_tiles - 1]
+                cum_excl = cum_incl - free_tiles
+                dropped = e_valid & (pos >= n_free)
+                overflow = (
+                    st.overflow + jnp.sum(dropped).astype(jnp.int32)
+                    + n_delay_over
+                )
+                placed = e_valid & ~dropped
+                # tile of the pos[j]-th free slot: the last tile whose
+                # exclusive cumulative free count is <= pos[j]
+                tj = jnp.clip(
+                    jnp.searchsorted(cum_excl, pos, side="right").astype(
+                        jnp.int32
+                    )
+                    - 1,
+                    0,
+                    n_tiles - 1,
+                )
+                loc_rank = pos - cum_excl[tj]
+                fv_rows = (~ev_valid_mid).reshape(n_tiles, p_tile)[tj]
+                frank = jnp.cumsum(fv_rows.astype(jnp.int32), axis=1) - 1
+                # distinct emits have distinct global ranks, so their
+                # (tile, local-rank) pairs are distinct — the match
+                # one-hots are disjoint and need no sequential chain
+                match = fv_rows & (frank == loc_rank[:, None]) & placed[:, None]
+                lj = jnp.sum(
+                    jnp.where(
+                        match,
+                        jnp.arange(p_tile, dtype=jnp.int32)[None, :],
+                        0,
+                    ),
+                    axis=1,
+                )
+                slot = jnp.where(
+                    placed, tj * p_tile + lj, jnp.int32(e_slots)
                 )
             else:
-                ev_emit = st.ev_emit
+                free = jnp.flatnonzero(
+                    ~ev_valid_mid, size=k1, fill_value=e_slots
+                )
+                slot = jnp.where(
+                    e_valid, free[jnp.clip(pos, 0, k1 - 1)], jnp.int32(e_slots)
+                )
+                dropped = e_valid & (slot >= e_slots)
+                overflow = (
+                    st.overflow + jnp.sum(dropped).astype(jnp.int32)
+                    + n_delay_over
+                )
+            if pool_index and rank_place:
+                # the within-tile select-chain write lowering (the
+                # PR-8 rank placement confined to each emit's target
+                # tile): per emit, gather the T-wide tile row, select
+                # the matched slot branchlessly, store the row back.
+                # Scatter-free in the ELEMENT sense but still one
+                # dynamic row store per emit per column — the
+                # interleaved A/B (SCALING.md round 9) measures it
+                # against the element stores below; element stores won
+                # on CPU, so "scatter" is the default under the index.
+                emt, emm, eme, ema, emp = _materialize(
+                    (e_time, e_meta, e_epoch, em.args, em.pay)
+                )
+                v2 = ev_valid_mid.reshape(n_tiles, p_tile)
+                t2 = ev_time_mid.reshape(n_tiles, p_tile)
+                m2 = ev_meta_mid.reshape(n_tiles, p_tile)
+                ep2 = st.ev_epoch.reshape(n_tiles, p_tile)
+                a2 = st.ev_args.reshape(n_tiles, p_tile, aw)
+                p2 = st.ev_pay.reshape(n_tiles, p_tile, w)
+                e2 = (
+                    st.ev_emit.reshape(n_tiles, p_tile)
+                    if timeline_cap else None
+                )
+                for j in range(k1):
+
+                    def upd(arr2, val, _s=match[j], _t=tj[j]):
+                        row = arr2[_t]
+                        m = _s.reshape((p_tile,) + (1,) * (row.ndim - 1))
+                        return arr2.at[_t].set(
+                            jnp.where(m, val, row).astype(arr2.dtype)
+                        )
+
+                    v2 = upd(v2, True)
+                    t2 = upd(t2, emt[j])
+                    m2 = upd(m2, emm[j])
+                    ep2 = upd(ep2, eme[j])
+                    a2 = upd(a2, ema[j])
+                    p2 = upd(p2, emp[j])
+                    if timeline_cap:
+                        e2 = upd(e2, now)
+                ev_valid = v2.reshape(e_slots)
+                ev_time = t2.reshape(e_slots)
+                ev_meta = m2.reshape(e_slots)
+                ev_epoch = ep2.reshape(e_slots)
+                ev_args = a2.reshape(e_slots, aw)
+                ev_pay = p2.reshape(e_slots, w)
+                ev_emit = (
+                    e2.reshape(e_slots) if timeline_cap else st.ev_emit
+                )
+            else:
+                ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
+                ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
+                ev_meta = ev_meta_mid.at[slot].set(e_meta, mode="drop")
+                ev_epoch = st.ev_epoch.at[slot].set(e_epoch, mode="drop")
+                ev_args = st.ev_args.at[slot].set(em.args, mode="drop")
+                ev_pay = st.ev_pay.at[slot].set(em.pay, mode="drop")
+                if timeline_cap:
+                    ev_emit = st.ev_emit.at[slot].set(
+                        jnp.broadcast_to(now, (k1,)), mode="drop"
+                    )
+                else:
+                    ev_emit = st.ev_emit
+            if pool_index:
+                # index maintenance, part 2: fold the insertions into
+                # their tiles' summaries (<= k1 scatter-min/add rows),
+                # then recompute the popped tile EXACTLY from the
+                # final pool rows: the consume can RAISE its minimum,
+                # which no incremental min can express, and the
+                # .at[wtile].set override also covers any insertion
+                # that landed there (set runs after the fold).
+                ins_tile = jnp.where(placed, tj, jnp.int32(n_tiles))
+                tile_cnt2 = tile_cnt_mid.at[ins_tile].add(1, mode="drop")
+                # mask EMPTY tiles back to the +inf sentinel before
+                # folding inserts: under time32 the per-step rebase
+                # decays every carried value — including the sentinel
+                # of a tile that has sat empty — so after ~2.1 sim
+                # seconds an unmasked min() against it would pin a
+                # freshly filled tile's minimum below its true value
+                # and silently pop the wrong event. The pop masks by
+                # tile_cnt at ITS use; this is the other use and needs
+                # the same mask (tests/test_pool_index.py
+                # test_time32_empty_tile_sentinel_decay is the repro).
+                tile_min2 = jnp.where(
+                    tile_cnt_mid > 0, tile_min_mid, t_inf
+                ).at[ins_tile].min(e_time, mode="drop")
+                fin_v = ev_valid.reshape(n_tiles, p_tile)[wtile]
+                fin_t = ev_time.reshape(n_tiles, p_tile)[wtile]
+                tile_min_out = tile_min2.at[wtile].set(
+                    jnp.min(jnp.where(fin_v, fin_t, t_inf))
+                )
+                tile_cnt_out = tile_cnt2.at[wtile].set(
+                    jnp.sum(fin_v.astype(jnp.int32))
+                )
+
+        if not pool_index:
+            n_tiles_in = st.tile_cnt.shape[0]
+            if n_tiles_in:
+                # index-preserving off-step: this build does not USE
+                # the index, but the state carries summaries (e.g. an
+                # auto-indexed CPU init feeding a forced dense run, or
+                # an indexed checkpoint resumed flat) — rebuild them
+                # exactly from the final pool so they can never go
+                # stale and poison a later indexed step. One fused
+                # O(E) reduce, the same cost class as the flat pop
+                # this build already pays.
+                tile_min_out, tile_cnt_out = build_pool_index(
+                    ev_time, ev_valid, e_slots // n_tiles_in
+                )
+            else:
+                tile_min_out, tile_cnt_out = st.tile_min, st.tile_cnt
 
         # ---- operation-history append (madsim_tpu.check) ----
         # the j-th valid record takes slot hist_count+j: same compact
@@ -2491,7 +2957,7 @@ def make_step(
         # here is ever read back by the trajectory, the RNG or the
         # trace, so latency=None runs are bit-identical.
         lat_feats = []  # (feature, on) pairs for the coverage fold
-        if lat_c:
+        if lat_c and not _lat_export:
             lat_inv, lat_resp = st.lat_inv, st.lat_resp
             lat_hist = st.lat_hist
             lat_count, lat_drop = st.lat_count, st.lat_drop
@@ -2804,7 +3270,7 @@ def make_step(
             _trace_fold(st.trace, now, kind, dst, args, pay_i),
             st.trace,
         )
-        return SimState(
+        out = SimState(
             seed=st.seed,
             now=now_after,
             step=st.step + jnp.uint32(1),
@@ -2853,9 +3319,124 @@ def make_step(
             lat_hist=lat_hist,
             lat_count=lat_count,
             lat_drop=lat_drop,
+            tile_min=tile_min_out,
+            tile_cnt=tile_cnt_out,
         )
+        if _lat_export:
+            # cold/hot split: hand the raw markers of this dispatch to
+            # the run builder — (valid (L,), (op, phase) rows (L, 2),
+            # the dispatch clock). The cold (C,)-wide columns passed
+            # through ``out`` untouched; the batch-level fold applies
+            # them only on steps where some seed actually marked.
+            return out, (user_dispatch & uem.lat_valid, uem.lat, now)
+        return out
 
     return step
+
+
+def _make_cold_lat_apply(latency: LatencySpec, ll: int):
+    """Batch-level fold of exported latency markers onto the cold bank.
+
+    The cold/hot split (``make_run(cold_split=True)``): per-seed steps
+    export raw ``(valid, (op, phase), now)`` markers instead of folding
+    them, and this function applies the EXACT in-step semantics —
+    first start wins, first response wins, window = the invoke-time
+    phase, out-of-range ids counted loudly — to the batched
+    ``(S, C)``-wide columns at once. The run builder calls it under a
+    ``lax.cond`` on "any seed marked this step", so the army's cold
+    columns are read and written only on marker steps (they are
+    otherwise not an operand of the scan body at all) — on CPU that
+    skips the work, on TPU it skips the HBM traffic, and the values
+    are bit-identical to the in-step tap by construction
+    (tests/test_pool_index.py pins it).
+    """
+    lat_c = latency.ops
+    lat_p = latency.phases
+    phase_ns = latency.phase_ns
+    edges = jnp.asarray(LAT_EDGES_NS)
+
+    def apply(cold, markers):
+        lat_inv, lat_resp, lat_hist, lat_count, lat_drop = cold
+        mval, mops, mnow = markers  # (S, L) bool, (S, L, 2) i32, (S,) i64
+        rows = jnp.arange(mnow.shape[0])
+        for j in range(ll):
+            mv = mval[:, j]
+            oid = mops[:, j, 0]
+            is_end = mops[:, j, 1] == jnp.int32(1)
+            in_r = (oid >= 0) & (oid < lat_c)
+            lat_drop = lat_drop + (mv & ~in_r).astype(jnp.int32)
+            act = mv & in_r
+            oc = jnp.clip(oid, 0, lat_c - 1)
+            inv_o = jnp.where(in_r, lat_inv[rows, oc], jnp.int64(-1))
+            resp_o = jnp.where(in_r, lat_resp[rows, oc], jnp.int64(-1))
+            do_start = act & ~is_end & (inv_o < 0)
+            do_end = act & is_end & (inv_o >= 0) & (resp_o < 0)
+            d = mnow - inv_o
+            bkt = jnp.sum(
+                (d[:, None] >= edges[None, :]).astype(jnp.int32), axis=1
+            )
+            ph = jnp.clip(
+                (inv_o // jnp.int64(phase_ns)).astype(jnp.int32),
+                0, lat_p - 1,
+            )
+            lat_inv = lat_inv.at[
+                rows, jnp.where(do_start, oc, jnp.int32(lat_c))
+            ].set(mnow, mode="drop")
+            lat_resp = lat_resp.at[
+                rows, jnp.where(do_end, oc, jnp.int32(lat_c))
+            ].set(mnow, mode="drop")
+            lat_hist = lat_hist.at[
+                rows, jnp.where(do_end, ph, jnp.int32(lat_p)), bkt
+            ].add(jnp.int32(1), mode="drop")
+            lat_count = lat_count + do_end.astype(jnp.int32)
+        return (lat_inv, lat_resp, lat_hist, lat_count, lat_drop)
+
+    return apply
+
+
+def _cold_split_body(step, apply):
+    """One scan/while iteration of the cold-split run: advance the hot
+    state, then fold the exported markers onto the cold bank only when
+    some seed marked (the lax.cond is a real device branch — the pred
+    is batch-level scalar, not vmapped)."""
+
+    def body(s: SimState) -> SimState:
+        s2, markers = step(s)
+        cold = (s2.lat_inv, s2.lat_resp, s2.lat_hist, s2.lat_count,
+                s2.lat_drop)
+        cold = lax.cond(
+            jnp.any(markers[0]),
+            lambda op: apply(op[0], op[1]),
+            lambda op: op[0],
+            (cold, markers),
+        )
+        return dataclasses.replace(
+            s2, lat_inv=cold[0], lat_resp=cold[1], lat_hist=cold[2],
+            lat_count=cold[3], lat_drop=cold[4],
+        )
+
+    return body
+
+
+def _resolve_cold_split(
+    wl: Workload, latency, cov_words: int, cold_split: bool
+) -> bool:
+    if not cold_split:
+        return False
+    if latency is None or wl.lat_markers == 0:
+        raise ValueError(
+            "cold_split needs the latency tap: a LatencySpec and a "
+            "workload with lat_markers > 0 (there is no cold bank "
+            "otherwise — the split would be a no-op)"
+        )
+    if cov_words:
+        raise ValueError(
+            "cold_split is incompatible with coverage (cov_words > 0): "
+            "the (window, latency-bucket) coverage features must fold "
+            "in-step; run hunts with the in-step tap and benches/obs "
+            "sweeps with the split"
+        )
+    return True
 
 
 def make_run(
@@ -2871,6 +3452,9 @@ def make_run(
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
     placement: str | None = None,
+    pool_index: bool | None = None,
+    rank_place_max_pool: int | None = None,
+    cold_split: bool = False,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -2885,11 +3469,33 @@ def make_run(
     trajectory that may diverge from the int64 layout. Callers must
     check ``overflow == 0`` before trusting per-seed results (bench.py
     and engine.search do; direct callers are responsible themselves).
+
+    ``cold_split=True`` lands the cold/hot split of the carried scan
+    state: the army latency clocks and the (C,)-wide per-op columns
+    (``lat_inv``/``lat_resp`` and the sketch) move to a cold bank the
+    loop touches only on marker steps — the per-seed step exports raw
+    markers and a batch-level ``lax.cond`` folds them (the exact
+    in-step semantics, bit-identical values). Requires the latency tap
+    and ``cov_words=0``; see :func:`_make_cold_lat_apply`.
     """
+    cold = _resolve_cold_split(wl, latency, cov_words, cold_split)
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
+        pool_index, rank_place_max_pool, _lat_export=cold,
     ))
+
+    if cold:
+        cbody = _cold_split_body(step, _make_cold_lat_apply(latency, wl.lat_markers))
+
+        def run(state: SimState) -> SimState:
+            def body(s, _):
+                return cbody(s), None
+
+            final, _ = lax.scan(body, state, None, length=n_steps)
+            return final
+
+        return run
 
     def run(state: SimState) -> SimState:
         def body(s, _):
@@ -2914,6 +3520,9 @@ def make_run_while(
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
     placement: str | None = None,
+    pool_index: bool | None = None,
+    rank_place_max_pool: int | None = None,
+    cold_split: bool = False,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -2927,12 +3536,19 @@ def make_run_while(
     The :func:`make_run` time32 contract applies here too: horizon-
     clamped timer delays are counted in ``state.overflow`` and the run
     silently continues — check ``overflow == 0`` before trusting
-    per-seed results.
+    per-seed results. ``cold_split`` follows the make_run contract
+    (cold latency bank folded batch-level only on marker steps).
     """
+    cold = _resolve_cold_split(wl, latency, cov_words, cold_split)
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
+        pool_index, rank_place_max_pool, _lat_export=cold,
     ))
+    advance = (
+        _cold_split_body(step, _make_cold_lat_apply(latency, wl.lat_markers))
+        if cold else step
+    )
 
     def run(state: SimState) -> SimState:
         def cond(carry):
@@ -2941,7 +3557,7 @@ def make_run_while(
 
         def body(carry):
             s, i = carry
-            return step(s), i + 1
+            return advance(s), i + 1
 
         final, _ = lax.while_loop(cond, body, (state, jnp.int64(0)))
         return final

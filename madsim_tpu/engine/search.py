@@ -62,21 +62,35 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
                     layout=None, plan_slots: int = 0, dup_rows: bool = False,
                     cov_words: int = 0, metrics: bool = False,
                     timeline_cap: int = 0, cov_hitcount: bool = False,
-                    latency=None, compact: bool = False):
+                    latency=None, compact: bool = False,
+                    pool_index: bool | None = None):
     # the ONE construction of a batched sweep's (init, run) pair —
     # make_sweep (the device-composable form) and search_seeds' cached
     # runner both build through here, so a flag added to one path cannot
     # silently miss the other and break host/device bit-identity
+    if pool_index is None:
+        # resolve pool_index HERE, against the layout this sweep will
+        # actually run (core.resolve_layout — the ONE default rule),
+        # and hand the same concrete bool to init and run: a forced
+        # layout= can then never make the two builders'
+        # auto-resolutions disagree (make_step's trace-time shape
+        # guard would catch it, but loudly failing a sweep over a
+        # resolvable default is worse than resolving it)
+        from .core import _resolve_pool_index, resolve_layout
+
+        pool_index = _resolve_pool_index(
+            cfg, None, dense=resolve_layout(layout) == "dense"
+        )
     obs_kw = dict(
         metrics=metrics, timeline_cap=timeline_cap,
         cov_hitcount=cov_hitcount, latency=latency,
     )
     init = make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
-                     **obs_kw)
+                     pool_index=pool_index, **obs_kw)
     mk = make_run_compacted if compact else make_run_while
     run = mk(
         wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-        cov_words=cov_words, **obs_kw,
+        cov_words=cov_words, pool_index=pool_index, **obs_kw,
     )
     return init, run
 
@@ -94,6 +108,7 @@ def make_sweep(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency=None,
+    pool_index: bool | None = None,
 ):
     """Build the traceable batched sweep: ``sweep(seeds[, rows]) -> view``.
 
@@ -110,7 +125,7 @@ def make_sweep(
         wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency,
+        latency=latency, pool_index=pool_index,
     )
 
     def sweep(seeds, rows=None):
@@ -126,13 +141,26 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   compact: bool, plan_slots: int = 0, dup_rows: bool = False,
                   cov_words: int = 0, metrics: bool = False,
                   timeline_cap: int = 0, cov_hitcount: bool = False,
-                  latency=None):
+                  latency=None, pool_index: bool | None = None):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
-    # entry serves every plan of the same width
+    # entry serves every plan of the same width. The env-defaulted
+    # knobs (pool_index auto threshold, rank-place crossover) are
+    # resolved BEFORE keying: a knob change mid-process must build a
+    # fresh program, not silently reuse one baked under the old value
+    from .core import (
+        _resolve_pool_index,
+        resolve_layout,
+        resolve_rank_place_max_pool,
+    )
+
+    if pool_index is None:
+        pool_index = _resolve_pool_index(
+            cfg, None, dense=resolve_layout(layout) == "dense"
+        )
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
-           latency)
+           latency, pool_index, resolve_rank_place_max_pool())
     if key not in _RUN_CACHE:
         # imported here: obs is a consumer of the engine — a module-level
         # import would run the whole obs package during engine import
@@ -142,7 +170,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
             wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
             dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
             timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-            latency=latency, compact=compact,
+            latency=latency, compact=compact, pool_index=pool_index,
         )
         # make_run_compacted jits internally per growth stage (its
         # build wall stays inside dispatch — documented limitation)
@@ -341,6 +369,7 @@ def search_seeds(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency=None,
+    pool_index: bool | None = None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -401,6 +430,11 @@ def search_seeds(
     ``obs.latency_reduce``, judge with ``check.slo_bounded`` as the
     invariant). All of them are derived state only — the traces and
     verdicts are bit-identical with them off or on.
+
+    ``pool_index`` picks the readiness-partitioned pool lowering
+    (make_step docstring; value-identical, auto on for CPU scatter
+    pools past the crossover) — it keys the compiled-run cache like
+    every other build flag.
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
@@ -462,6 +496,7 @@ def search_seeds(
     init, run, _ = _compiled_run(
         wl, cfg, max_steps, layout, compact, plan_slots, dup_rows,
         cov_words, metrics, timeline_cap, cov_hitcount, latency,
+        pool_index,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):

@@ -113,9 +113,14 @@ def check_layouts(
         variants += [("dense", True), ("scatter", True)]
     runs = {}
     for layout, t32 in variants:
-        init = make_init(wl, cfg, time32=t32)
+        # pool_index pinned OFF: the dense layout has no tile index,
+        # and this check's subject is the dense/scatter duality — the
+        # indexed lowering has its own on/off identity pins
+        # (tests/test_pool_index.py, lint-soak cert 1c)
+        init = make_init(wl, cfg, time32=t32, pool_index=False)
         runs[(layout, t32)] = jax.jit(
-            make_run(wl, cfg, n_steps, layout=layout, time32=t32)
+            make_run(wl, cfg, n_steps, layout=layout, time32=t32,
+                     pool_index=False)
         )(init(seeds))
     base_key = ("dense", False)
     base = runs[base_key]

@@ -426,6 +426,7 @@ def _build_programs(
     wl, cfg, space, *, invariant, batch, max_steps, cov_words, layout,
     require_halt, select_top, max_corpus, vcap, max_ops, inherit_seed_p,
     cov_hitcount, metrics, latency, mesh, seed_corpus, cache_key,
+    pool_index=None,
 ):
     """Build one cache entry: the (uniform, breed, refs) triple.
 
@@ -452,6 +453,7 @@ def _build_programs(
         wl, cfg, max_steps, layout=layout, plan_slots=p_slots,
         dup_rows=dup, cov_words=cov_words, metrics=metrics,
         timeline_cap=0, cov_hitcount=cov_hitcount, latency=latency,
+        pool_index=pool_index,
     )
     k_ov = len(seed_corpus)
     if k_ov:
@@ -730,6 +732,7 @@ def run_device(
     metrics: bool = False,
     mesh=None,
     viol_cap: int | None = None,
+    pool_index: bool | None = None,
 ) -> ExploreReport:
     """Run one exploration campaign with every generation device-resident.
 
@@ -879,6 +882,7 @@ def run_device(
         cov_words, layout, require_halt, select_top, int(max_corpus), vcap,
         max_ops, float(inherit_seed_p), bool(cov_hitcount), bool(metrics),
         latency, _mesh_key(mesh), tuple(lp.hash() for lp in seed_corpus),
+        pool_index,
     )
     prog_uniform, prog_breed = _gen_programs(
         key,
@@ -889,7 +893,7 @@ def run_device(
             max_corpus=int(max_corpus), vcap=vcap, max_ops=max_ops,
             inherit_seed_p=inherit_seed_p, cov_hitcount=cov_hitcount,
             metrics=metrics, latency=latency, mesh=mesh,
-            seed_corpus=seed_corpus, cache_key=key,
+            seed_corpus=seed_corpus, cache_key=key, pool_index=pool_index,
         ),
     )
 

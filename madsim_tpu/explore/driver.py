@@ -301,6 +301,7 @@ def run(
     resume=None,
     checkpoint_path: str | None = None,
     latency=None,
+    pool_index: bool | None = None,
 ) -> ExploreReport:
     """Run one coverage-guided exploration campaign.
 
@@ -481,7 +482,7 @@ def run(
             history_invariant=history_invariant,
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
             cov_words=cov_words, cov_hitcount=cov_hitcount,
-            latency=latency,
+            latency=latency, pool_index=pool_index,
         )
         t_after = _time.monotonic()  # lint: allow(wall-clock)
         # the trace/lower/compile share of this dispatch (nonzero only

@@ -179,6 +179,7 @@ def check_noninterference(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    pool_index: bool | None = None,
     n_steps: int = 4,
     n_seeds: int = 2,
     mutate=None,
@@ -209,7 +210,7 @@ def check_noninterference(
     flags = dict(
         layout=layout, time32=time32, placement=placement, dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
-        cov_hitcount=cov_hitcount,
+        cov_hitcount=cov_hitcount, pool_index=pool_index,
         # JSON-able form (reports serialize): the spec's defining triple
         latency=(
             (latency.ops, latency.phases, latency.phase_ns)
@@ -224,19 +225,19 @@ def check_noninterference(
     init = make_init(
         wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency,
+        latency=latency, pool_index=pool_index,
     )
     state = init(np.zeros(max(n_seeds, 1), np.uint64))
     if entry == "step":
         fn = make_step(
             wl, cfg, layout=layout, time32=time32, placement=placement,
-            **obs_kw,
+            pool_index=pool_index, **obs_kw,
         )
         template = jax.tree.map(lambda a: a[0], state)
     elif entry == "run":
         fn = make_run(
             wl, cfg, n_steps, layout=layout, time32=time32,
-            placement=placement, **obs_kw,
+            placement=placement, pool_index=pool_index, **obs_kw,
         )
         template = state
     elif entry == "sharded_run":
@@ -259,7 +260,7 @@ def check_noninterference(
         state = init(np.zeros(rows, np.uint64))
         run_fn = make_run(
             wl, cfg, n_steps, layout=layout, time32=time32,
-            placement=placement, **obs_kw,
+            placement=placement, pool_index=pool_index, **obs_kw,
         )
         spec = _P(mesh.axis_names)
         fn = _par.shard_map_nocheck(
@@ -410,6 +411,19 @@ LAYOUT_AXES = (
     ("dense", False, None),
     ("scatter", True, "rank"),
     ("dense", True, None),
+    # the readiness-partitioned pool (ISSUE 13): the indexed program an
+    # army-scale CPU pool compiles — tile-summary pop, per-tile free
+    # search, element-store placement. The tile summary columns are
+    # derived BY CONSTRUCTION (rebuilt on restore, excluded from the
+    # checkpoint format) but trajectory-coupled, so they sit on the
+    # CORE side of this proof: the obligation here is that no obs
+    # column ever reaches them (or anything else core) through the new
+    # index arithmetic; their own value-correctness certificate is the
+    # index on/off bit-identity pin (tests/test_pool_index.py,
+    # tools/lint_soak.py cert 1c). The time32 pair covers the rebased
+    # tile minima.
+    ("scatter", False, None, True),
+    ("scatter", True, None, True),
 )
 
 # The sharded-campaign matrix entry (ROADMAP lint follow-on; required
@@ -473,12 +487,13 @@ def check_matrix(
     tests pass a slice for the tier-1 smoke. ``layouts`` sweeps
     (layout, time32[, placement]) lowering tuples per cell
     (``LAYOUT_AXES`` is the full set; two-tuples mean the auto
-    placement); the single ``layout`` argument remains the
-    one-lowering form. A model whose (workload, config) is not
-    time32-eligible is skipped for time32 pairs rather than failing
-    the matrix.
+    placement, four-tuples add the pool_index axis); the single
+    ``layout`` argument remains the one-lowering form. A model whose
+    (workload, config) is not time32-eligible is skipped for time32
+    pairs, and one whose pool has no tile divisor is skipped for
+    pool-index rows, rather than failing the matrix.
     """
-    from ..engine.core import time32_eligible
+    from ..engine.core import pool_index_eligible, time32_eligible
 
     if models is not None and not models:
         # an explicitly empty slice is a caller bug (e.g. a tag filter
@@ -491,12 +506,15 @@ def check_matrix(
     for name, wl, cfg in (models if models is not None else model_matrix()):
         for lay, t32, *rest in layouts:
             place = rest[0] if rest else None
+            pidx = rest[1] if len(rest) > 1 else None
             if t32 and not time32_eligible(wl, cfg):
+                continue
+            if pidx and not pool_index_eligible(cfg):
                 continue
             for axis, flags in (axes or BUILD_AXES).items():
                 rep = check_noninterference(
                     wl, cfg, entry=entry, layout=lay, time32=t32,
-                    placement=place, **flags,
+                    placement=place, pool_index=pidx, **flags,
                 )
                 rep.flags["axis"] = axis
                 if log is not None:

@@ -53,6 +53,13 @@ class TestStepIdentityLean:
     def test_raftlog_army_dense(self):
         _check("raftlog/army-obs", layout="dense")
 
+    def test_raftlog_army_pool_indexed(self):
+        # the readiness-partitioned pool (ISSUE 13) against the SAME
+        # pre-refactor digests: the tile summaries are excluded from
+        # the digest (derived by construction), so the indexed program
+        # must reproduce every other SimState field bit-for-bit
+        _check("raftlog/army-obs", layout="scatter", pool_index=True)
+
 
 @pytest.mark.slow
 class TestStepIdentityPlacements:
@@ -74,6 +81,21 @@ class TestStepIdentityMatrix:
     @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
     def test_scatter(self, name):
         _check(name, layout="scatter")
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_pool_indexed(self, name):
+        # the indexed pool with element-store writes (the default
+        # under the index) on every captured scenario
+        _check(name, layout="scatter", pool_index=True)
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_pool_indexed_rank_chains(self, name):
+        # the within-tile select-chain write lowering
+        _check(name, layout="scatter", pool_index=True, placement="rank")
+
+    @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
+    def test_pool_indexed_compacted(self, name):
+        _check(name, compact=True, pool_index=True)
 
     @pytest.mark.parametrize("name", sorted(step_goldens.scenarios()))
     def test_scatter_store_placement(self, name):

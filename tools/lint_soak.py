@@ -8,16 +8,27 @@ Four certificates:
    kvchaos additionally with the client-army latency markers, raftlog
    additionally with the disk discipline on) x every observability
    build axis (base / metrics / timeline / coverage / hit-count /
-   latency / all) x every lowering pair (scatter/int64, dense, time32
-   where eligible), traced via the single-seed step AND the vmapped
-   ``make_run`` scan path, plus the sharded-campaign row (every model
-   under the campaign tap set, proved through the ``shard_map`` call
-   boundary — the program shape ``explore.run_device`` dispatches),
-   plus the flight-recorder boundary row (the same campaign program
-   traced with an ``obs.prof.ProgramProfiler`` active: no
-   host-callback primitive, taint unchanged — the flight taps are
-   provably host-side): every derived column provably isolated from
-   every core column and the trace fold.
+   latency / all) x every lowering tuple (scatter/int64, dense, time32
+   where eligible, and the readiness-indexed pool rows — ISSUE 13:
+   the tile-summary columns sit on the CORE side, so the proof
+   obligation over the indexed program is that no obs column reaches
+   them or anything else core), traced via the single-seed step AND
+   the vmapped ``make_run`` scan path, plus the sharded-campaign row
+   (every model under the campaign tap set, proved through the
+   ``shard_map`` call boundary — the program shape
+   ``explore.run_device`` dispatches), plus the flight-recorder
+   boundary row (the same campaign program traced with an
+   ``obs.prof.ProgramProfiler`` active: no host-callback primitive,
+   taint unchanged — the flight taps are provably host-side): every
+   derived column provably isolated from every core column and the
+   trace fold.
+
+   **1c (dynamic):** the tile summaries' own derived-only certificate
+   — a taint proof cannot state "value-identical", so the pool-index
+   row is paired with a runtime bit-identity check: the indexed and
+   flat lowerings produce identical traces/pools/histories on a
+   chaos-bearing batch, and the carried summaries equal a
+   from-scratch ``engine.build_pool_index`` rebuild.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
    value-identical op reading a metrics counter into the RNG cursor)
    is caught, with the offending equation chain and the column names.
@@ -98,6 +109,60 @@ def main() -> None:
         for r in bad:
             print(r.summary())
     print(f"cert1 {'PASS' if not bad else 'FAIL'} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+
+    # ---- certificate 1c: pool-index derived-only, the dynamic half ----
+    # (the static rows above prove obs isolation over the indexed
+    # program; bit-identity of the indexed lowering itself is a VALUE
+    # property no taint walk can witness — certified here at runtime)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    print("== cert 1c: readiness-index on/off bit-identity (dynamic) ==")
+    import dataclasses as _dc
+
+    import numpy as _np
+
+    from madsim_tpu.engine import (
+        POOL_INDEX_STATE_FIELDS,
+        build_pool_index,
+        make_init,
+        make_run,
+        pool_tile,
+    )
+
+    _wl = make_raft(record=True)
+    _cfg = EngineConfig(
+        pool_size=40, loss_p=0.02, clog_backoff_max_ns=2_000_000_000
+    )
+    _seeds = _np.arange(64, dtype=_np.uint64)
+    _a = jax.block_until_ready(jax.jit(make_run(
+        _wl, _cfg, 300, layout="scatter", pool_index=False
+    ))(make_init(_wl, _cfg, pool_index=False)(_seeds)))
+    _b = jax.block_until_ready(jax.jit(make_run(
+        _wl, _cfg, 300, layout="scatter", pool_index=True
+    ))(make_init(_wl, _cfg, pool_index=True)(_seeds)))
+    _div = [
+        f.name for f in _dc.fields(_a)
+        if f.name not in POOL_INDEX_STATE_FIELDS
+        and not _np.array_equal(
+            _np.asarray(getattr(_a, f.name)), _np.asarray(getattr(_b, f.name))
+        )
+    ]
+    _tm, _tc = build_pool_index(
+        _b.ev_time, _b.ev_valid, pool_tile(_cfg.pool_size)
+    )
+    _mask = _np.asarray(_tc) > 0
+    _sum_ok = _np.array_equal(
+        _np.asarray(_tc), _np.asarray(_b.tile_cnt)
+    ) and _np.array_equal(
+        _np.asarray(_tm)[_mask], _np.asarray(_b.tile_min)[_mask]
+    )
+    if _div or not _sum_ok:
+        failures.append("pool-index-identity")
+        print(f"  DIVERGED fields={_div} summaries_ok={_sum_ok}")
+    else:
+        print(f"  indexed == flat over {len(_dc.fields(_a)) - 2} fields; "
+              f"carried summaries == from-scratch rebuild")
+    print(f"cert1c {'PASS' if not (_div or not _sum_ok) else 'FAIL'} "
           f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
 
     # ---- certificate 2: the planted met->step leak is caught ----

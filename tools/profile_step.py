@@ -22,7 +22,16 @@ Rows (JSONL, one per config):
 Usage:
 
     python tools/profile_step.py [config ...] > PROFILE_CPU_rNN.jsonl
+    python tools/profile_step.py --pool-sweep   # ISSUE-13 pool-size axis
     make profile
+
+The ``--pool-sweep`` axis measures the O(E)-vs-O(ready) claim behind
+the readiness-partitioned pool (ISSUE 13): raftlog at pool sizes
+512/2048/8192 with the client army on and off, timing the flat
+lowering against the indexed one (both write lowerings) plus
+nop-handler ablations, so "the flat pop/free-search scales with pool
+width and the index removes it" is a measured attribution, not an
+asserted one (evidence PROFILE_CPU_r07.jsonl).
 
 Not part of tier-1 (pure measurement, no assertions).
 """
@@ -41,13 +50,18 @@ import numpy as np
 import jax
 from jax import lax
 
-from madsim_tpu.engine import EngineConfig, make_init
+from madsim_tpu.engine import EngineConfig, LatencySpec, make_init
 from madsim_tpu.engine.core import make_step
 from madsim_tpu.models import BENCH_SPECS
 
 DEFAULT_CONFIGS = ("raftlog", "kvchaos", "raft")
 N_SEEDS = 4096
 N_STEPS = 200
+
+# the pool-size sweep axis (ISSUE 13): (pool_size, n_seeds) — seeds
+# shrink as pools grow so the flat O(E) cells stay within budget
+POOL_SWEEP = ((512, 512), (2048, 256), (8192, 128))
+POOL_SWEEP_STEPS = 200
 
 
 def _nop_handler(ctx):
@@ -136,13 +150,118 @@ def profile_config(name: str, n_seeds: int = N_SEEDS, n_steps: int = N_STEPS) ->
     return row
 
 
+def _time_pool_variant(wl, cfg, rows, slots, lat, n_seeds, n_steps, **mk) -> float:
+    """Best-of-2 wall of a jitted plan-seeded scan, ns per seed-step."""
+    step = jax.vmap(make_step(wl, cfg, layout="scatter", latency=lat, **mk))
+
+    def run(st):
+        final, _ = lax.scan(
+            lambda s, _: (step(s), None), st, None, length=n_steps
+        )
+        return final
+
+    r = jax.jit(run)
+    init = make_init(wl, cfg, plan_slots=slots, latency=lat,
+                     pool_index=mk.get("pool_index"))
+    seeds = np.arange(n_seeds, dtype=np.uint64)
+    st = init(seeds, rows) if rows is not None else init(seeds)
+    jax.block_until_ready(r(st))  # compile
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()  # lint: allow(wall-clock)
+        jax.block_until_ready(r(st))
+        best = min(best, time.perf_counter() - t0)  # lint: allow(wall-clock)
+    return best / (n_seeds * n_steps) * 1e9
+
+
+def profile_pool_sweep() -> list:
+    """The ISSUE-13 pool-size axis: raftlog x {512, 2048, 8192} x
+    {army on, off}, flat vs indexed (both write lowerings) vs
+    nop-handler ablations — pop/free-search wall attributed by
+    differencing, exactly the profile methodology above."""
+    from madsim_tpu.chaos import CrashStorm, FaultPlan
+    from madsim_tpu.models import make_raftlog
+    from madsim_tpu.models import raftlog as rl_mod
+
+    out = []
+    for pool, n_seeds in POOL_SWEEP:
+        for army in (True, False):
+            wl = make_raftlog(record=True, army=army)
+            wl_nop = dataclasses.replace(
+                wl, handlers=tuple(_nop_handler for _ in wl.handlers),
+                handler_names=None,
+            )
+            cfg = EngineConfig(pool_size=pool, loss_p=0.02,
+                               clog_backoff_max_ns=2_000_000_000)
+            if army:
+                n_ops = max(pool // 2 - 64, 64)
+                plan = FaultPlan((
+                    rl_mod.client_army(
+                        n_ops=n_ops, t_min_ns=5_000_000,
+                        t_max_ns=3_000_000_000,
+                    ),
+                    CrashStorm(targets=tuple(range(5)), n=1,
+                               t_min_ns=50_000_000, t_max_ns=200_000_000,
+                               down_min_ns=20_000_000,
+                               down_max_ns=80_000_000),
+                ))
+                lat = LatencySpec(ops=n_ops, phases=3)
+                slots = plan.slots
+                rows = plan.compile_batch(
+                    np.arange(n_seeds, dtype=np.uint64), wl=wl
+                )
+            else:
+                n_ops, lat, slots, rows = 0, None, 0, None
+
+            def t(w, **mk):
+                return _time_pool_variant(
+                    w, cfg, rows, slots, lat, n_seeds, POOL_SWEEP_STEPS,
+                    **mk,
+                )
+
+            ns = {
+                "flat": t(wl, pool_index=False),
+                "indexed": t(wl, pool_index=True, placement="scatter"),
+                "indexed_rank_chains": t(wl, pool_index=True,
+                                         placement="rank"),
+                "flat_nop": t(wl_nop, pool_index=False),
+                "indexed_nop": t(wl_nop, pool_index=True,
+                                 placement="scatter"),
+            }
+            out.append({
+                "config": "raftlog/pool-sweep",
+                "platform": jax.devices()[0].platform,
+                "pool_size": pool,
+                "army_ops": n_ops,
+                "n_seeds": n_seeds,
+                "n_steps": POOL_SWEEP_STEPS,
+                "ns_per_seed_step": {k: round(v, 1) for k, v in ns.items()},
+                "attribution": {
+                    "handlers": round(ns["flat"] - ns["flat_nop"], 1),
+                    "pop+placement (index delta)": round(
+                        ns["flat"] - ns["indexed"], 1
+                    ),
+                    "pop argmin + free search (nop index delta)": round(
+                        ns["flat_nop"] - ns["indexed_nop"], 1
+                    ),
+                },
+                "speedup_indexed": round(ns["flat"] / ns["indexed"], 2),
+            })
+            print(json.dumps(out[-1]), flush=True)
+    return out
+
+
 def main() -> None:
-    names = sys.argv[1:] or list(DEFAULT_CONFIGS)
+    args = [a for a in sys.argv[1:] if a != "--pool-sweep"]
+    sweep = "--pool-sweep" in sys.argv[1:]
+    names = args or ([] if sweep else list(DEFAULT_CONFIGS))
     for name in names:
         if name not in BENCH_SPECS:
             raise SystemExit(f"unknown config {name!r} (BENCH_SPECS)")
         row = profile_config(name)
         print(json.dumps(row), flush=True)
+    if sweep:
+        profile_pool_sweep()
 
 
 if __name__ == "__main__":

@@ -26,12 +26,15 @@ SHELL   := /bin/bash
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
         store-soak latency-soak lint lint-soak profile clean \
-        campaign-bench flight pool-bench pool-bench-smoke
+        campaign-bench flight pool-bench pool-bench-smoke \
+        verify-bench verify-bench-smoke
 
-check: native lint test determinism bench-smoke flight pool-bench-smoke
+check: native lint test determinism bench-smoke flight pool-bench-smoke \
+       verify-bench-smoke
 	@echo "== make check: all gates passed =="
 
-check-full: native lint test-full determinism bench-smoke flight pool-bench-smoke
+check-full: native lint test-full determinism bench-smoke flight \
+            pool-bench-smoke verify-bench-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -75,6 +78,27 @@ pool-bench:
 
 pool-bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/pool_bench.py --smoke
+
+# Device-resident verification A/B (tools/verify_bench.py, ISSUE 14):
+# device == numpy verdict identity (lockstep + prefix-compacting
+# runner), the host-vs-device history-hunt campaign A/B (device >= 3x
+# generations/s at 65k seeds/generation with history screens on,
+# bit-identical outcomes, _GEN_CACHE retraces == 1), the >= 10x
+# host-transfer-bytes reduction (verdict words + flagged-seed
+# histories vs full columns), and the find -> host-replay ->
+# Wing-Gong-escalation path on the kvchaos lost-write mutant. The
+# VERIFY_r09.txt evidence artifact; the smoke (identity + accounting +
+# tiny A/B, no floors) rides `make check`.
+VERIFY_BATCH  ?= 65536
+VERIFY_GENS   ?= 4
+VERIFY_ROUNDS ?= 2
+verify-bench:
+	$(PY) tools/verify_bench.py $(VERIFY_BATCH) $(VERIFY_GENS) \
+	    $(VERIFY_ROUNDS) > VERIFY_r09.txt; rc=$$?; \
+	    cat VERIFY_r09.txt; exit $$rc
+
+verify-bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/verify_bench.py --smoke
 
 native:
 	$(MAKE) -C native

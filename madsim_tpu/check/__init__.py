@@ -26,6 +26,17 @@ quantile breaches an SLO bound, read off the engine's latency sketches
 (``search_seeds(latency=...)``) — an SLO breach is a violation like
 any other, searchable, shrinkable and replayable.
 
+The cheap batch layer also exists as **device kernels** (check/
+device.py): every vectorized detector restated as a jitted jnp kernel
+over the on-device history columns, vmapped over seeds and traceable
+under ``shard_map`` — bit-identical verdicts, consumed by
+``engine.search_seeds(device_check=...)``,
+``explore.run_device(history_check=...)`` and the compacted runner's
+history prefix-compaction. A :class:`HistoryScreen` is the hashable
+spec naming one detector (the invariant identity the program caches
+key on); ``device.screens_invariant`` turns a screen set back into the
+numpy ``history_invariant`` for host-driver replays.
+
 The history layers import nothing from the engine — they are pure
 host-side consumers of the recorded columns, usable on engine results,
 compacted search views, and Recorder histories alike (check/slo.py
@@ -48,6 +59,8 @@ from .history import (  # noqa: F401
     HistoryError,
     Op,
 )
+from . import device  # noqa: F401
+from .device import HistoryScreen  # noqa: F401
 from .linearize import LinResult, check_kv, check_register  # noqa: F401
 from .recorder import Recorder  # noqa: F401
 from .slo import slo_bounded, slo_breaches  # noqa: F401
@@ -74,7 +87,9 @@ __all__ = [
     "OP_WRITE",
     "BatchHistory",
     "HistoryError",
+    "HistoryScreen",
     "LinResult",
+    "device",
     "Op",
     "Recorder",
     "check_kv",

@@ -1,0 +1,572 @@
+"""Device-resident batch history detectors: the jnp port of
+check/vectorized.py, traceable into the programs that *produce* the
+histories.
+
+The numpy detectors judge a sweep only after every seed's raw history
+columns have crossed the device→host boundary — at 65k seeds that
+transfer (S·H·5 int32 words + S·H int64 clocks) plus the serial numpy
+passes is the slow half of a verified sweep. This module restates each
+detector as a pure jnp kernel over the SAME on-device columns
+(``hist_word``/``hist_t``/``hist_count``/``hist_drop``), vmapped over
+the seed axis, so verification runs inside (or right next to) the
+simulation program and the host receives **packed verdict words**
+(one bit per seed) instead of columns. Three consumers:
+
+* ``engine.search_seeds(device_check=...)`` — history sweeps that
+  transfer verdict words plus the *flagged* seeds' full histories
+  (the Wing–Gong escalation input) instead of every column;
+* ``explore.run_device(history_check=...)`` — the detector joins the
+  cached generation program, closing the host-driver-only
+  ``history_invariant`` gap for guided hunts;
+* ``engine.make_run_compacted(hist_screen=...)`` — bank-time
+  **prefix-compaction**: responded (invoke, response) pairs a clean
+  verdict has already covered fold out of the banked columns
+  (:func:`fold_verified`, loud ``hist_fold`` accounting).
+
+Verdict contract: **bit-identical to the numpy path.** Each kernel is
+an algebraic restatement (O(H²) pairwise masks instead of per-(key,
+client) python loops) of the corresponding ``check.vectorized``
+function — same floor construction, same FIFO rank matching, same
+three response shapes (paired invoke / bare response / malformed
+invoke-after), same quarantine rule (a seed whose buffer dropped
+records is judged as an EMPTY history; callers void its verdict via
+``hist_drop`` exactly like the host path). tests/test_check_device.py
+pins device == numpy per detector across scatter/dense/time32 and the
+compacted runner, on clean and planted-mutant models.
+
+The escalation discipline is unchanged: these screens are the cheap
+batch layer; any seed they flag ships its *full* history to the host
+for exact Wing–Gong confirmation (check/linearize.py) — the PR-1
+cross-check rule. ``fold_verified`` preserves exactly that: flagged
+seeds keep every record."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .history import (
+    COL_ARG,
+    COL_CLIENT,
+    COL_KEY,
+    COL_OK,
+    COL_OP,
+    OK_OK,
+    OK_PENDING,
+    OP_READ,
+    OP_USER,
+    OP_WRITE,
+    BatchHistory,
+)
+
+__all__ = [
+    "HistoryScreen",
+    "as_screens",
+    "default_screens",
+    "election_safety",
+    "fold_verified",
+    "monotonic_reads",
+    "monotonic_reads_strict",
+    "pack_verdicts",
+    "pack_verdicts_host",
+    "read_your_writes",
+    "recovery_safety",
+    "screen_ok",
+    "screens_invariant",
+    "slo_breaches",
+    "stale_reads",
+    "unpack_verdicts",
+]
+
+_MIN = -(2**62)  # "no prior write" floor sentinel (vectorized._MIN)
+
+# seed-axis chunk the batched kernels map over: the pairwise (H, H)
+# masks are materialized per chunk, bounding peak memory to
+# chunk·H²-scale booleans no matter how large the sweep is. Chunking
+# is a pure evaluation schedule — verdicts are value-identical for any
+# chunk size.
+_CHUNK = 2048
+
+
+def _cols(word):
+    """(H,5) int32 row -> the five columns, arg widened like numpy."""
+    return (
+        word[:, COL_OP],
+        word[:, COL_KEY],
+        word[:, COL_ARG].astype(jnp.int64),
+        word[:, COL_CLIENT],
+        word[:, COL_OK],
+    )
+
+
+def _floor_ok(word, count, read_op: int, write_op: int, own_only: bool):
+    """Per-seed core of stale_reads / read_your_writes / monotonic_reads:
+    the invoke-interval-aware floor check of
+    ``vectorized._read_floor_violations``, restated pairwise.
+
+    For every successful read response j, its FIFO-rank-matched invoke
+    is found (the r-th response of a (client, key) read group pairs the
+    r-th invoke of the same group), and the read's value must be at
+    least the newest completed write version as of that invoke — or as
+    of the response's own buffer slot when no invoke record exists (a
+    bare/instantaneous event), and unconstrained when the rank-matched
+    invoke sits AFTER the response (malformed interleaving:
+    under-flag, never false-flag). Returns () bool, True = clean.
+    """
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    w_resp = valid & (op == write_op) & (ok == OK_OK)
+    r_inv = valid & (op == read_op) & (ok == OK_PENDING)
+    r_resp = valid & (op == read_op) & (ok == OK_OK)
+    # same (client, key) read group — op is fixed by the masks
+    grp = (client[:, None] == client[None, :]) & (key[:, None] == key[None, :])
+    lt = idx[:, None] < idx[None, :]
+    # rank of each invoke/response within its own group (count of
+    # strictly-earlier group members) — vectorized's cumsum ranks
+    inv_rank = jnp.sum(lt & r_inv[:, None] & grp, axis=0)
+    resp_rank = jnp.sum(lt & r_resp[:, None] & grp, axis=0)
+    # the rank-matched invoke of response j: the unique group invoke
+    # whose rank equals j's response rank (anywhere in the buffer —
+    # position sorts into the three shapes below), h_dim if none
+    match = r_inv[:, None] & grp & (inv_rank[:, None] == resp_rank[None, :])
+    has_inv = jnp.any(match, axis=0)
+    inv_idx = jnp.where(has_inv, jnp.argmax(match, axis=0), h_dim).astype(
+        jnp.int32
+    )
+    # floor sample position per response: the invoke's slot (paired op),
+    # the response's own slot (no invoke ever), exclusive either way
+    pos = jnp.where(has_inv, inv_idx, idx)
+    sel_w = w_resp[:, None] & (key[:, None] == key[None, :])
+    if own_only:
+        sel_w = sel_w & (client[:, None] == client[None, :])
+    before = idx[:, None] < pos[None, :]
+    floor = jnp.max(
+        jnp.where(sel_w & before, arg[:, None], jnp.int64(_MIN)), axis=0
+    )
+    # malformed interleaving (rank-matched invoke after the response):
+    # no constraint
+    floor = jnp.where(has_inv & (inv_idx > idx), jnp.int64(_MIN), floor)
+    return ~jnp.any(r_resp & (arg < floor))
+
+
+def _strict_ok(word, count, read_op: int):
+    """Per-seed ``monotonic_reads_strict``: within a (client, key)
+    group of successful reads, no later response returns a smaller
+    value than ANY earlier one — equivalent to the numpy adjacent-pair
+    pass over the (client, key)-sorted rows (a decreasing adjacent pair
+    exists iff a decreasing pair exists at all)."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    m = valid & (op == read_op) & (ok == OK_OK)
+    pair = (
+        m[:, None] & m[None, :]
+        & (idx[:, None] < idx[None, :])
+        & (client[:, None] == client[None, :])
+        & (key[:, None] == key[None, :])
+    )
+    return ~jnp.any(pair & (arg[None, :] < arg[:, None]))
+
+
+def _election_ok(word, count, elect_op: int):
+    """Per-seed ``election_safety``: no two successful elect records
+    share a key (term) with different args (winners) — the same
+    pairwise pass as the numpy detector."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    m = valid & (op == elect_op) & (ok == OK_OK)
+    bad = (
+        m[:, None] & m[None, :]
+        & (key[:, None] == key[None, :])
+        & (arg[:, None] != arg[None, :])
+    )
+    return ~jnp.any(bad)
+
+
+def _recovery_ok(word, count, sync_op: int, recover_op: int):
+    """Per-seed ``recovery_safety``: a recover record's arg is never
+    below the SAME client's latest earlier sync arg (the last sync, not
+    the running max — legitimate truncations re-sync)."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    sync_m = valid & (op == sync_op) & (ok == OK_OK)
+    rec_m = valid & (op == recover_op) & (ok == OK_OK)
+    same_c = client[:, None] == client[None, :]
+    # latest same-client sync at-or-before each row (numpy's inclusive
+    # running max over marked indices; -1 = none yet)
+    cand = sync_m[:, None] & same_c & (idx[:, None] <= idx[None, :])
+    last = jnp.max(
+        jnp.where(cand, idx[:, None], jnp.int32(-1)), axis=0
+    )
+    floor = jnp.max(
+        jnp.where(
+            cand & (idx[:, None] == last[None, :]),
+            arg[:, None],
+            jnp.int64(_MIN),
+        ),
+        axis=0,
+    )
+    return ~jnp.any(rec_m & (last >= 0) & (arg < floor))
+
+
+@dataclasses.dataclass(frozen=True)
+class HistoryScreen:
+    """One vectorized detector as a device kernel + its numpy oracle.
+
+    Value-hashable (a frozen literal), so it can key the compiled-
+    program caches (``engine.search._SCREEN_CACHE``,
+    ``explore.device._GEN_CACHE``) — the *invariant identity* cache-key
+    component. Build instances through the module constructors
+    (:func:`stale_reads` etc.), which mirror the ``check.vectorized``
+    names and defaults.
+
+    ``op_a``/``op_b`` mean (read, write) for the floor detectors,
+    (elect, -) for election safety and (sync, recover) for recovery
+    safety — exactly the positional ops of the numpy functions.
+    """
+
+    kind: str
+    op_a: int = OP_READ
+    op_b: int = OP_WRITE
+
+    def __post_init__(self):
+        if self.kind not in _KERNELS:
+            raise ValueError(
+                f"unknown screen kind {self.kind!r} "
+                f"(one of {sorted(_KERNELS)})"
+            )
+
+    def seed_kernel(self, word, count):
+        """Traceable per-seed verdict: (H,5) int32 word rows + () count
+        -> () bool, True = clean. Vmap over seeds (or let
+        :func:`screen_ok` do it, chunked)."""
+        return _KERNELS[self.kind](word, count, self)
+
+    def host(self, h: BatchHistory) -> np.ndarray:
+        """The numpy oracle: the exact ``check.vectorized`` function
+        this screen ports, on a host :class:`BatchHistory`."""
+        from . import vectorized as v
+
+        fn = {
+            "stale_reads": lambda: v.stale_reads(h, self.op_a, self.op_b),
+            "read_your_writes": lambda: v.read_your_writes(
+                h, self.op_a, self.op_b
+            ),
+            "monotonic_reads": lambda: v.monotonic_reads(h, self.op_a),
+            "monotonic_reads_strict": lambda: v.monotonic_reads_strict(
+                h, self.op_a
+            ),
+            "election_safety": lambda: v.election_safety(h, self.op_a),
+            "recovery_safety": lambda: v.recovery_safety(
+                h, self.op_a, self.op_b
+            ),
+        }[self.kind]
+        return fn()
+
+
+_KERNELS = {
+    "stale_reads": lambda w, c, s: _floor_ok(
+        w, c, s.op_a, s.op_b, own_only=False
+    ),
+    "read_your_writes": lambda w, c, s: _floor_ok(
+        w, c, s.op_a, s.op_b, own_only=True
+    ),
+    "monotonic_reads": lambda w, c, s: _floor_ok(
+        w, c, s.op_a, s.op_a, own_only=True
+    ),
+    "monotonic_reads_strict": lambda w, c, s: _strict_ok(w, c, s.op_a),
+    "election_safety": lambda w, c, s: _election_ok(w, c, s.op_a),
+    "recovery_safety": lambda w, c, s: _recovery_ok(w, c, s.op_a, s.op_b),
+}
+
+
+def stale_reads(read_op: int = OP_READ, write_op: int = OP_WRITE):
+    """Lost-write screen: ``check.vectorized.stale_reads`` on device."""
+    return HistoryScreen("stale_reads", read_op, write_op)
+
+
+def read_your_writes(read_op: int = OP_READ, write_op: int = OP_WRITE):
+    return HistoryScreen("read_your_writes", read_op, write_op)
+
+
+def monotonic_reads(read_op: int = OP_READ):
+    """Invoke-interval-aware monotonic reads (the sound default)."""
+    return HistoryScreen("monotonic_reads", read_op, read_op)
+
+
+def monotonic_reads_strict(read_op: int = OP_READ):
+    """Response-order monotonic reads (opt-in; unsound for pipelined
+    reads — the ``check.vectorized`` caveat applies verbatim)."""
+    return HistoryScreen("monotonic_reads_strict", read_op, read_op)
+
+
+def election_safety(elect_op: int):
+    return HistoryScreen("election_safety", elect_op, 0)
+
+
+def recovery_safety(sync_op: int, recover_op: int):
+    return HistoryScreen("recovery_safety", sync_op, recover_op)
+
+
+def default_screens() -> tuple:
+    """The generic screen set over the shared op namespace — every
+    built-in detector at its conventional ops. Used by the lint
+    ``CHECK_AXES`` row (taint structure is op-independent); real sweeps
+    pass the model's own ops."""
+    return (
+        stale_reads(),
+        read_your_writes(),
+        monotonic_reads(),
+        election_safety(OP_USER),
+        recovery_safety(OP_USER + 2, OP_USER + 3),
+    )
+
+
+def as_screens(spec) -> tuple:
+    """Normalize a screen spec (one screen or an iterable) to a tuple."""
+    if isinstance(spec, HistoryScreen):
+        return (spec,)
+    screens = tuple(spec)
+    if not screens or not all(
+        isinstance(s, HistoryScreen) for s in screens
+    ):
+        raise ValueError(
+            f"device check must be a HistoryScreen or a non-empty "
+            f"iterable of them, got {spec!r}"
+        )
+    return screens
+
+
+def _chunked_seed_map(per_seed, word, count):
+    """vmap ``per_seed`` over the seed axis, mapping in ``_CHUNK``-seed
+    chunks past the threshold (bounds the pairwise masks' memory to
+    chunk-scale no matter the sweep size); a non-dividing batch is
+    padded with empty histories (count 0 — trivially clean) and
+    sliced back. Value-identical either way."""
+    s_dim = word.shape[0]
+    vm = jax.vmap(per_seed)
+    if s_dim <= _CHUNK:
+        return vm(word, count)
+    pad = (-s_dim) % _CHUNK
+    if pad:
+        word = jnp.concatenate(
+            [word, jnp.zeros((pad,) + word.shape[1:], word.dtype)]
+        )
+        count = jnp.concatenate([count, jnp.zeros((pad,), count.dtype)])
+    n = word.shape[0]
+    wr = word.reshape((n // _CHUNK, _CHUNK) + word.shape[1:])
+    cr = count.reshape((n // _CHUNK, _CHUNK))
+    out = lax.map(lambda xc: vm(*xc), (wr, cr)).reshape(n)
+    return out[:s_dim] if pad else out
+
+
+def screen_ok(screens, word, t, count, drop):
+    """Batched device verdict: (S,H,5)/(S,H)/(S,)/(S,) history columns
+    -> (S,) bool, True = every screen clean.
+
+    Traceable (jit / vmap / shard_map); ``t`` rides along for signature
+    symmetry with the column set (no built-in screen reads clocks —
+    buffer order IS dispatch order). Seeds whose buffer dropped records
+    are judged as EMPTY histories (trivially clean), matching the
+    ``search_seeds`` quarantine: their verdicts are voided via
+    ``hist_drop``, never trusted.
+    """
+    del t
+    screens = as_screens(screens)
+    count = jnp.where(drop > 0, 0, count)
+
+    def per_seed(w, c):
+        ok = jnp.bool_(True)
+        for s in screens:
+            ok = ok & s.seed_kernel(w, c)
+        return ok
+
+    return _chunked_seed_map(per_seed, word, count)
+
+
+def screens_invariant(screens):
+    """The host form of a screen set: a ``search_seeds``
+    ``history_invariant`` callable running the numpy oracles — the
+    bit-identical reference arm of every device == host pin, and the
+    replay path for device-found history violations on the host
+    driver."""
+    screens = as_screens(screens)
+
+    def invariant(h: BatchHistory) -> np.ndarray:
+        ok = np.ones(len(h), bool)
+        for s in screens:
+            ok &= np.asarray(s.host(h), bool)
+        return ok
+
+    invariant.__name__ = "+".join(s.kind for s in screens)
+    return invariant
+
+
+# ---------------------------------------------------------------------------
+# verdict words — the transfer format
+# ---------------------------------------------------------------------------
+
+
+def pack_verdicts(ok):
+    """(S,) bool verdicts -> (ceil(S/32),) uint32 packed words (bit
+    ``s % 32`` of word ``s // 32`` = seed s clean; pad bits 0). The
+    per-seed transfer format of a device-checked sweep: 1 bit/seed
+    instead of the full history columns."""
+    ok = jnp.asarray(ok, jnp.bool_)
+    s_dim = ok.shape[0]
+    pad = (-s_dim) % 32
+    if pad:
+        ok = jnp.concatenate([ok, jnp.zeros((pad,), jnp.bool_)])
+    bits = ok.reshape(-1, 32).astype(jnp.uint32) << jnp.arange(
+        32, dtype=jnp.uint32
+    )[None, :]
+    # distinct bit positions per lane: sum == bitwise or
+    return jnp.sum(bits, axis=1).astype(jnp.uint32)
+
+
+def unpack_verdicts(words, n_seeds: int) -> np.ndarray:
+    """Host inverse of :func:`pack_verdicts` -> (n_seeds,) bool."""
+    w = np.asarray(words, np.uint32)
+    bits = (w[:, None] >> np.arange(32, dtype=np.uint32)[None, :]) & 1
+    return bits.reshape(-1)[:n_seeds].astype(bool)
+
+
+def pack_verdicts_host(ok) -> np.ndarray:
+    """Numpy mirror of :func:`pack_verdicts` (for verdicts that are
+    already host-side, e.g. the compacted runner's banked ``hist_ok``)."""
+    ok = np.asarray(ok, bool)
+    pad = (-ok.shape[0]) % 32
+    if pad:
+        ok = np.concatenate([ok, np.zeros((pad,), bool)])
+    bits = ok.reshape(-1, 32).astype(np.uint32) << np.arange(
+        32, dtype=np.uint32
+    )[None, :]
+    return bits.sum(axis=1, dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# history prefix-compaction
+# ---------------------------------------------------------------------------
+
+
+def _fifo_unmatched(inv, resp, grp, idx):
+    """Invokes left pending by the exact FIFO pairing discipline of
+    ``BatchHistory.ops``: each response closes the OLDEST still-open
+    earlier invoke of its (client, op, key) group; a response with no
+    open invoke is instantaneous and consumes nothing."""
+    h_dim = inv.shape[0]
+
+    def body(j, matched):
+        cand = inv & ~matched & grp[:, j] & (idx < j)
+        has = resp[j] & jnp.any(cand)
+        first = jnp.argmax(cand)
+        return matched.at[first].set(matched[first] | has)
+
+    matched = lax.fori_loop(0, h_dim, body, jnp.zeros((h_dim,), jnp.bool_))
+    return inv & ~matched
+
+
+def fold_verified(word, t, count, drop, ok):
+    """Bank-time history prefix-compaction (the ``make_run_compacted``
+    ``hist_screen`` fold): for seeds a device screen judged CLEAN, the
+    responded operations — every response record plus its FIFO-matched
+    invoke — fold out of the columns; only still-pending invokes
+    survive, compacted to the front in buffer order. Returns
+    ``(word2, t2, count2, fold)`` with ``fold`` the per-seed folded
+    record count (``hist_fold`` — the hist_drop-style loud accounting:
+    original count == count2 + fold, always).
+
+    The escalation path is untouched **by construction**: a flagged
+    seed (``ok`` False) or an overflowed one (``drop`` > 0) keeps every
+    record verbatim (fold == 0), so exact Wing–Gong confirmation always
+    sees the full history.
+    """
+    h_dim = word.shape[1]
+    if h_dim == 0:
+        return word, t, count, jnp.zeros_like(count)
+
+    def per_seed(w, tt, c, d, okv):
+        idx = jnp.arange(h_dim, dtype=jnp.int32)
+        valid = idx < c
+        op, key, _arg, client, okc = _cols(w)
+        inv = valid & (okc == OK_PENDING)
+        resp = valid & (okc != OK_PENDING)
+        grp = (
+            (client[:, None] == client[None, :])
+            & (op[:, None] == op[None, :])
+            & (key[:, None] == key[None, :])
+        )
+        keep_f = _fifo_unmatched(inv, resp, grp, idx)
+        do_fold = okv & (d == 0)
+        keep = jnp.where(do_fold, keep_f, valid)
+        # stable compaction: kept rows first, original order preserved
+        order = jnp.argsort(~keep, stable=True)
+        n_keep = jnp.sum(keep).astype(c.dtype)
+        mask = idx < n_keep
+        w2 = jnp.where(mask[:, None], w[order], 0)
+        t2 = jnp.where(mask, tt[order], 0)
+        return w2, t2, n_keep, (c - n_keep).astype(c.dtype)
+
+    return jax.vmap(per_seed)(word, t, count, drop, ok)
+
+
+# ---------------------------------------------------------------------------
+# the latency detector
+# ---------------------------------------------------------------------------
+
+
+def slo_breaches(lat_hist, bound_ns: int, q: float = 0.99,
+                 min_ops: int = 16):
+    """Device port of ``check.slo.slo_breaches``: (S, P, B) per-seed
+    latency sketches -> (S,) bool, True = some window PROVABLY
+    breaches (the quantile bucket's lower edge exceeds the bound — the
+    under-flag-never-false-flag rule, same rank convention as
+    ``obs.hist_quantile_bucket``). Traceable, so SLO verdicts can join
+    a device-resident program like the history screens do."""
+    from ..engine.core import LAT_EDGES_NS, N_LAT_BUCKETS
+
+    if not 0.0 < q < 1.0:
+        raise ValueError(f"q must be in (0, 1), got {q}")
+    if min_ops < 1:
+        raise ValueError(f"min_ops must be >= 1, got {min_ops}")
+    h = jnp.asarray(lat_hist).astype(jnp.int64)
+    if h.ndim != 3 or h.shape[2] != N_LAT_BUCKETS:
+        raise ValueError(
+            f"lat_hist must be (S, P, {N_LAT_BUCKETS}), got shape {h.shape}"
+        )
+    total = h.sum(axis=-1)  # (S, P)
+    rank = jnp.maximum(
+        jnp.ceil(q * total).astype(jnp.int64), jnp.int64(1)
+    )
+    cum = jnp.cumsum(h, axis=-1)
+    bucket = jnp.argmax(cum >= rank[..., None], axis=-1)
+    bucket = jnp.where(total > 0, bucket, -1)
+    edges = jnp.asarray(LAT_EDGES_NS)
+    bc = jnp.clip(bucket, 0, None)
+    lo = jnp.where(
+        bc <= 0,
+        jnp.int64(0),
+        edges[jnp.clip(bc - 1, 0, N_LAT_BUCKETS - 2)],
+    )
+    breach = (total >= min_ops) & (bucket >= 0) & (lo > jnp.int64(bound_ns))
+    return jnp.any(breach, axis=-1)

@@ -24,6 +24,13 @@ Scope (documented assumptions, not silent ones):
 * Seeds whose history buffer overflowed are *not* judged here: callers
   (``search_seeds``) quarantine them via ``hist_drop``; these passes
   simply see the stored prefix.
+
+This module is the **authoritative oracle**: every detector also
+exists as a device-resident jnp kernel (check/device.py) whose
+verdicts must match these bit for bit — the rank-matching guard paths
+(paired invoke / bare response / malformed invoke-after) are pinned
+per detector by the oracle table in tests/test_check_device.py, so a
+change here without a matching kernel change fails the identity pins.
 """
 
 from __future__ import annotations

@@ -103,6 +103,10 @@ RESULT_FIELDS = (
     "lat_drop",
 )
 
+# extra banked outputs of a ``hist_screen`` run (not SimState fields):
+# the per-seed device verdict and the prefix-compaction fold counter
+SCREEN_FIELDS = ("hist_ok", "hist_fold")
+
 
 def _phase_sizes(s0: int, shrink: int, min_size: int) -> list[int]:
     sizes = [s0]
@@ -129,6 +133,7 @@ def make_run_compacted(
     placement: str | None = None,
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
+    hist_screen=None,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -141,6 +146,21 @@ def make_run_compacted(
     ``shrink``/``min_size`` set the static phase schedule; with
     ``min_size >= n_seeds`` the program degenerates to exactly one
     while_loop — the plain ``make_run_while``.
+
+    ``hist_screen`` (a ``check.device.HistoryScreen`` or tuple of them)
+    turns on device-resident verification with history
+    **prefix-compaction**: the moment a bank of halted rows leaves the
+    hot loop, the screen kernels judge their histories ON DEVICE and —
+    for seeds the screen passed — responded (invoke, response) pairs
+    fold out of the banked columns (``check.device.fold_verified``),
+    so the device→host transfer carries only still-pending invokes
+    plus the *flagged* seeds' full histories. Two extra result fields
+    appear: ``hist_ok`` (the per-seed verdict, computed BEFORE the
+    fold) and ``hist_fold`` (records folded — loud, ``hist_drop``-
+    style accounting: original count == hist_count + hist_fold).
+    Flagged and overflowed seeds keep every record verbatim, so the
+    exact-checker escalation (Wing–Gong over flagged seeds) is
+    unaffected by construction. Requires ``wl.history``.
     """
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
@@ -155,9 +175,42 @@ def make_run_compacted(
         raise ValueError(f"shrink must be >= 2, got {shrink}")
     if min_size < 1:
         raise ValueError(f"min_size must be >= 1, got {min_size}")
+    if hist_screen is not None:
+        # imported here: check.device is a consumer of the engine
+        from ..check.device import as_screens, fold_verified, screen_ok
+
+        if wl.history is None:
+            raise ValueError(
+                f"hist_screen judges operation histories, but workload "
+                f"{wl.name!r} has Workload.history=None"
+            )
+        screens = as_screens(hist_screen)
+        hist_fields = ("hist_word", "hist_t", "hist_count", "hist_drop")
+        missing = [f for f in hist_fields if f not in fields]
+        if missing:
+            raise ValueError(
+                f"hist_screen needs the history columns banked; "
+                f"fields is missing {missing}"
+            )
+    else:
+        screens = None
 
     def _bank(st: SimState, idx: jnp.ndarray) -> dict:
         out = {f: getattr(st, f) for f in fields}
+        if screens is not None:
+            # bank-time device verification + prefix-compaction: the
+            # verdict judges the FULL history (identical to screening
+            # the uncompacted run), then clean seeds' responded pairs
+            # fold out of what ships to the host
+            ok = screen_ok(
+                screens, st.hist_word, st.hist_t, st.hist_count,
+                st.hist_drop,
+            )
+            w2, t2, c2, fold = fold_verified(
+                st.hist_word, st.hist_t, st.hist_count, st.hist_drop, ok
+            )
+            out["hist_word"], out["hist_t"], out["hist_count"] = w2, t2, c2
+            out["hist_ok"], out["hist_fold"] = ok, fold
         out["_idx"] = idx
         return out
 
@@ -205,15 +258,40 @@ def make_run_compacted(
     # input-sized allocation is cheap next to the loop carries
     jitted = jax.jit(compiled)
 
+    out_fields = fields if screens is None else fields + SCREEN_FIELDS
+
     def assemble(banked) -> SimpleNamespace:
-        """Device->host transfer + scatter back into original seed order."""
+        """Device->host transfer + scatter back into original seed order.
+
+        Under a ``hist_screen``, the folded history columns transfer
+        only up to the longest surviving record count across the banks
+        (fetched first — one tiny counter read): the fold's whole point
+        is that the big (rows, H, ...) column transfer shrinks to the
+        pending-invoke prefix plus the flagged seeds' full histories.
+        """
         s0 = sum(np.asarray(b["_idx"]).shape[0] for b in banked)
+        trim = {}
+        if screens is not None:
+            kept = max(
+                (int(np.asarray(b["hist_count"]).max(initial=0))
+                 for b in banked),
+                default=0,
+            )
+            trim = {"hist_word": kept, "hist_t": kept}
         out = {}
-        for f in fields:
-            proto = np.asarray(banked[0][f])
-            buf = np.zeros((s0,) + proto.shape[1:], proto.dtype)
+        for f in out_fields:
+            proto = banked[0][f]
+            buf = np.zeros((s0,) + tuple(proto.shape[1:]), proto.dtype)
+            k = trim.get(f)
             for b in banked:
-                buf[np.asarray(b["_idx"])] = np.asarray(b[f])
+                if k is None:
+                    buf[np.asarray(b["_idx"])] = np.asarray(b[f])
+                else:
+                    # device-side slice: only the surviving prefix
+                    # crosses the boundary (rows past hist_count are
+                    # zero by the fold, so the untransferred tail of
+                    # the host buffer is value-identical)
+                    buf[np.asarray(b["_idx"]), :k] = np.asarray(b[f][:, :k])
             out[f] = buf
         return SimpleNamespace(**out)
 

@@ -1166,6 +1166,21 @@ class Workload:
     # Marker semantics are derived-state-only: the markers do nothing
     # at all unless the step is built with a LatencySpec.
     lat_markers: int = 0
+    # protocol-specific coverage features (madsim_tpu.explore): a
+    # traceable hook ``cov_features(node_state, now) -> iterable of
+    # (feature, on)`` pairs — feature a uint32 word (the engine
+    # namespaces it under its own tag before hashing into the bitmap),
+    # ``on`` a () bool gate (ANDed with the user-dispatch gate). Runs
+    # once per dispatched event over the POST-dispatch fleet state, so
+    # a workload can contribute guidance signals the generic taps
+    # cannot see — e.g. the fleet's commit-index spread (raftlog's
+    # ``cov_spread=True``): a schedule that drags replicas apart is
+    # new behavior even when no individual event is. Coverage is
+    # derived state, so the hook CHANGES BITMAPS ONLY: traces,
+    # trajectories and verdicts are bit-identical with it on or off
+    # (and campaigns must not mix hooks, the cov_hitcount rule). None
+    # (default) = no extra features, bitmaps unchanged.
+    cov_features: Callable | None = None
     # user purposes to PREFETCH into the per-dispatch batched RNG block
     # (the BatchRNG shape, PAPERS.md): handler draws at these purposes
     # (the ints passed to ctx.draw.user/user_int) are served from lanes
@@ -3160,6 +3175,22 @@ def make_step(
             # bucket) features computed in the latency block above
             for f_lat, on_lat in lat_feats:
                 cov, cov_hits = _tap(cov, cov_hits, f_lat, on_lat)
+            # workload-contributed protocol features (Workload.
+            # cov_features): post-dispatch fleet state -> (feature, on)
+            # pairs, namespaced under tag 6 so they can never collide
+            # with the engine's own feature families
+            if wl.cov_features is not None:
+                for f_wl, on_wl in wl.cov_features(node_state, now):
+                    # mask to the 24-bit feature payload BEFORE tagging:
+                    # a hook word with high bits set must alias other
+                    # tag-6 features, never another family's namespace
+                    f_wl = (
+                        jnp.asarray(f_wl).astype(jnp.uint32)
+                        & jnp.uint32((1 << 24) - 1)
+                    ) | jnp.uint32(6 << 24)
+                    cov, cov_hits = _tap(
+                        cov, cov_hits, f_wl, user_dispatch & on_wl
+                    )
             if dense or rank_place:
                 cov_last = jnp.where(
                     dst_oh & user_dispatch, kind, st.cov_last

@@ -36,7 +36,7 @@ import numpy as np
 
 import jax
 
-from .compact import RESULT_FIELDS, make_run_compacted
+from .compact import RESULT_FIELDS, SCREEN_FIELDS, make_run_compacted
 from .core import (
     EngineConfig,
     Workload,
@@ -57,13 +57,36 @@ __all__ = ["SearchReport", "make_sweep", "search_seeds"]
 # (SearchReport.build_wall_s).
 _RUN_CACHE: dict = {}
 
+# compiled device-verification programs, keyed on the screen tuple (a
+# value-hashable invariant identity — check.device.HistoryScreen): the
+# screen kernels + the verdict-word pack as ONE jitted program applied
+# to the run's device-resident history columns. AotProgram entries, so
+# retraces are counted like every other cached program.
+_SCREEN_CACHE: dict = {}
+
+
+def _screen_prog(screens: tuple):
+    prog = _SCREEN_CACHE.get(screens)
+    if prog is None:
+        from ..check.device import pack_verdicts, screen_ok
+        from ..obs.prof import AotProgram
+
+        def run_screen(word, t, count, drop):
+            ok = screen_ok(screens, word, t, count, drop)
+            return pack_verdicts(ok)
+
+        prog = _SCREEN_CACHE[screens] = AotProgram(
+            "engine.search.screen", screens, run_screen
+        )
+    return prog
+
 
 def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
                     layout=None, plan_slots: int = 0, dup_rows: bool = False,
                     cov_words: int = 0, metrics: bool = False,
                     timeline_cap: int = 0, cov_hitcount: bool = False,
                     latency=None, compact: bool = False,
-                    pool_index: bool | None = None):
+                    pool_index: bool | None = None, hist_screen=None):
     # the ONE construction of a batched sweep's (init, run) pair —
     # make_sweep (the device-composable form) and search_seeds' cached
     # runner both build through here, so a flag added to one path cannot
@@ -87,11 +110,19 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
     )
     init = make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
                      pool_index=pool_index, **obs_kw)
-    mk = make_run_compacted if compact else make_run_while
-    run = mk(
-        wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
-        cov_words=cov_words, pool_index=pool_index, **obs_kw,
-    )
+    if compact:
+        run = make_run_compacted(
+            wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
+            cov_words=cov_words, pool_index=pool_index,
+            hist_screen=hist_screen, **obs_kw,
+        )
+    else:
+        # the lockstep path screens AFTER the run, as a separate cached
+        # program over the still-device-resident columns (_screen_prog)
+        run = make_run_while(
+            wl, cfg, max_steps, layout=layout, dup_rows=dup_rows,
+            cov_words=cov_words, pool_index=pool_index, **obs_kw,
+        )
     return init, run
 
 
@@ -141,7 +172,8 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   compact: bool, plan_slots: int = 0, dup_rows: bool = False,
                   cov_words: int = 0, metrics: bool = False,
                   timeline_cap: int = 0, cov_hitcount: bool = False,
-                  latency=None, pool_index: bool | None = None):
+                  latency=None, pool_index: bool | None = None,
+                  hist_screen=None):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width. The env-defaulted
@@ -160,7 +192,8 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
         )
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
-           latency, pool_index, resolve_rank_place_max_pool())
+           latency, pool_index, resolve_rank_place_max_pool(),
+           hist_screen)
     if key not in _RUN_CACHE:
         # imported here: obs is a consumer of the engine — a module-level
         # import would run the whole obs package during engine import
@@ -171,6 +204,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
             dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
             timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
             latency=latency, compact=compact, pool_index=pool_index,
+            hist_screen=hist_screen,
         )
         # make_run_compacted jits internally per growth stage (its
         # build wall stays inside dispatch — documented limitation)
@@ -242,6 +276,20 @@ class SearchReport:
     lat_hist: np.ndarray | None = None
     lat_count: np.ndarray | None = None
     lat_dropped: np.ndarray | None = None
+    # device-verification columns (device_check=...): the per-seed
+    # screen verdict (True = clean), its packed uint32 transfer form
+    # (ceil(S/32) words — what actually crossed the device boundary on
+    # the lockstep path), and the escalation input: the FULL histories
+    # of exactly the seeds the screen flagged (and that did not
+    # overflow), as a check.BatchHistory over flagged_idx rows — feed
+    # these to the exact Wing–Gong checker for confirmation (the PR-1
+    # cross-check discipline). hist_fold (compact path only) counts
+    # records prefix-compaction folded out per seed.
+    screen_ok: np.ndarray | None = None
+    verdict_words: np.ndarray | None = None
+    flagged_idx: np.ndarray | None = None
+    flagged_history: object | None = None
+    hist_fold: np.ndarray | None = None
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -327,6 +375,20 @@ class SearchReport:
                 f"LatencySpec.ops) — their sketches undercount; size "
                 f"LatencySpec.ops to cover every army op id"
             )
+        if self.screen_ok is not None:
+            n_flag = (
+                len(self.flagged_idx) if self.flagged_idx is not None
+                else int((~self.screen_ok).sum())
+            )
+            fold = (
+                f", {int(self.hist_fold.sum())} records prefix-compacted"
+                if self.hist_fold is not None else ""
+            )
+            lines.append(
+                f"  device screen: {n_flag} flagged seed(s) escalated "
+                f"with full histories ({len(self.verdict_words)} verdict "
+                f"words transferred{fold})"
+            )
         plan = f" plan_hash={self.plan_hash}" if self.plan_hash else ""
         for s in bad[:limit]:
             lines.append(
@@ -338,12 +400,18 @@ class SearchReport:
         return "\n".join(lines)
 
 
-def _state_view(out) -> Mapping[str, np.ndarray]:
+def _state_view(out, keep_device: tuple = ()) -> Mapping[str, np.ndarray]:
     """Host-side numpy views of EVERY final-state field, keyed by name
     (the checkpoint.py pattern) — invariants can reach anything,
-    including paused/clog chaos state and the raw event pool."""
+    including paused/clog chaos state and the raw event pool.
+    ``keep_device`` names stay as device arrays (a device-checked sweep
+    never materializes the big history columns on the host — that is
+    the transfer the verdict words replace)."""
     return {
-        f.name: np.asarray(getattr(out, f.name))
+        f.name: (
+            getattr(out, f.name) if f.name in keep_device
+            else np.asarray(getattr(out, f.name))
+        )
         for f in dataclasses.fields(out)
     }
 
@@ -370,6 +438,7 @@ def search_seeds(
     cov_hitcount: bool = False,
     latency=None,
     pool_index: bool | None = None,
+    device_check=None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -435,14 +504,48 @@ def search_seeds(
     (make_step docstring; value-identical, auto on for CPU scatter
     pools past the crossover) — it keys the compiled-run cache like
     every other build flag.
+
+    ``device_check`` (a ``check.device.HistoryScreen`` or tuple of
+    them) is the device-resident form of ``history_invariant``
+    (mutually exclusive with it): the batch detectors run as jnp
+    kernels over the still-device-resident history columns, and the
+    host receives **packed verdict words** (``report.verdict_words``,
+    one bit per seed) plus the *flagged* seeds' full histories
+    (``report.flagged_history`` — the exact-checker escalation input)
+    instead of every seed's columns. Verdicts are bit-identical to the
+    numpy path (``check.device.screens_invariant(screens)`` is the
+    reference arm); overflowed seeds are quarantined identically. With
+    ``compact=True`` the screen additionally runs at bank time inside
+    the compacted program and **prefix-compacts** the banked columns
+    (``report.hist_fold`` counts the folded records; flagged seeds
+    keep full histories — see ``make_run_compacted``).
     """
     if history_invariant is not None and wl.history is None:
         raise ValueError(
             f"history_invariant needs operation histories, but workload "
             f"{wl.name!r} has Workload.history=None"
         )
-    if invariant is None and history_invariant is None:
-        raise ValueError("need an invariant, a history_invariant, or both")
+    screens = None
+    if device_check is not None:
+        from ..check.device import as_screens
+
+        screens = as_screens(device_check)
+        if wl.history is None:
+            raise ValueError(
+                f"device_check judges operation histories, but workload "
+                f"{wl.name!r} has Workload.history=None"
+            )
+        if history_invariant is not None:
+            raise ValueError(
+                "pass device_check OR history_invariant, not both: they "
+                "are the same verdict on two execution paths (compare "
+                "them via check.device.screens_invariant in a test, not "
+                "in one sweep)"
+            )
+    if invariant is None and history_invariant is None and screens is None:
+        raise ValueError(
+            "need an invariant, a history_invariant or a device_check"
+        )
     if plan is not None and plan_rows is not None:
         raise ValueError("pass plan OR plan_rows, not both")
     if seeds is None:
@@ -497,6 +600,10 @@ def search_seeds(
         wl, cfg, max_steps, layout, compact, plan_slots, dup_rows,
         cov_words, metrics, timeline_cap, cov_hitcount, latency,
         pool_index,
+        # only the compacted program embeds the screen (bank-time fold);
+        # the lockstep path screens via _screen_prog, so its run cache
+        # entry must stay shared with unscreened sweeps
+        hist_screen=screens if compact else None,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):
@@ -517,10 +624,17 @@ def search_seeds(
         state0 = init(seeds)
     if compact:
         out = run(state0)
-        view = {f: getattr(out, f) for f in RESULT_FIELDS}
+        fields = RESULT_FIELDS if screens is None else (
+            RESULT_FIELDS + SCREEN_FIELDS
+        )
+        view = {f: getattr(out, f) for f in fields}
     else:
         out = jax.block_until_ready(run(state0))
-        view = _state_view(out)
+        view = _state_view(
+            out,
+            keep_device=("hist_word", "hist_t") if screens is not None
+            else (),
+        )
     if invariant is not None:
         ok = np.asarray(invariant(view), dtype=bool)
         if ok.shape != (n_seeds,):
@@ -532,6 +646,45 @@ def search_seeds(
         ok = np.ones((n_seeds,), dtype=bool)
     pool_overflowed = np.asarray(view["overflow"]) > 0
     overflowed = pool_overflowed
+    dev_ok = None
+    verdict_words = None
+    flagged_idx = None
+    flagged_history = None
+    if screens is not None:
+        from ..check.device import pack_verdicts_host, unpack_verdicts
+        from ..check.history import BatchHistory
+
+        if compact:
+            # bank-time verdicts (computed on device BEFORE the fold)
+            dev_ok = np.asarray(view["hist_ok"], bool)
+            verdict_words = pack_verdicts_host(dev_ok)
+        else:
+            # THE history transfer of a device-checked sweep: ceil(S/32)
+            # packed words instead of (S, H, 5) + (S, H) columns
+            verdict_words = np.asarray(
+                _screen_prog(screens)(
+                    out.hist_word, out.hist_t, out.hist_count,
+                    out.hist_drop,
+                )
+            )
+            dev_ok = unpack_verdicts(verdict_words, n_seeds)
+        ok = ok & dev_ok
+        # escalation: exactly the flagged (and trustworthy) seeds ship
+        # their FULL histories to the host — the Wing–Gong
+        # confirmation input, the PR-1 cross-check discipline
+        hist_drop_np = np.asarray(view["hist_drop"])
+        flagged_idx = np.nonzero(~dev_ok & ~(hist_drop_np > 0))[0]
+        w, tt = view["hist_word"], view["hist_t"]
+        flagged_history = BatchHistory(
+            # device gather + transfer of only the flagged rows on the
+            # lockstep path; plain numpy take on the compact path
+            # (whose columns arrived prefix-compacted, flagged seeds
+            # verbatim-full by construction)
+            word=np.asarray(w[flagged_idx]),
+            t=np.asarray(tt[flagged_idx]),
+            count=np.asarray(view["hist_count"])[flagged_idx],
+            drop=hist_drop_np[flagged_idx],
+        )
     if history_invariant is not None:
         # imported here: check is a consumer of the engine, not a
         # dependency (engine -> check at module import would be a cycle)
@@ -603,5 +756,13 @@ def search_seeds(
         ),
         lat_dropped=(
             np.asarray(view["lat_drop"]) > 0 if latency is not None else None
+        ),
+        screen_ok=dev_ok,
+        verdict_words=verdict_words,
+        flagged_idx=flagged_idx,
+        flagged_history=flagged_history,
+        hist_fold=(
+            np.asarray(view["hist_fold"])
+            if screens is not None and compact else None
         ),
     )

@@ -45,11 +45,23 @@ scores), same coverage map, same violations, same replay keys — the
 device path is a lowering, not a fork. ``checkpoint_path`` / ``resume``
 interoperate with host-driver checkpoints in both directions.
 
+History hunts go device-resident too: ``history_check`` (a
+``check.device.HistoryScreen`` set) traces the vectorized batch
+detectors INTO the generation program — the detector's verdict folds
+into the violation mask right next to the sweep that recorded the
+histories, the screen identity joins the ``_GEN_CACHE`` key, and a
+guided hunt over history bugs (lost writes, election safety,
+recovery regressions) runs end-to-end without a host round-trip.
+Finds replay on the host driver via
+``check.device.screens_invariant(screens)`` — bit-identical verdicts,
+so the two drivers still agree corpus-for-corpus.
+
 Limitations vs the host driver: the invariant must be a *traceable*
 final-state predicate (jnp ops over the state view — it runs inside
-the device program; numpy-only predicates and ``history_invariant``
-checkers need the host driver), and ``compact=True`` has no device
-equivalent (the sweep runs ``make_run_while``).
+the device program; numpy-only predicates and arbitrary host
+``history_invariant`` callables beyond the screen set need the host
+driver), and ``compact=True`` has no device equivalent (the sweep
+runs ``make_run_while``).
 """
 
 from __future__ import annotations
@@ -426,7 +438,7 @@ def _build_programs(
     wl, cfg, space, *, invariant, batch, max_steps, cov_words, layout,
     require_halt, select_top, max_corpus, vcap, max_ops, inherit_seed_p,
     cov_hitcount, metrics, latency, mesh, seed_corpus, cache_key,
-    pool_index=None,
+    pool_index=None, history_check=None,
 ):
     """Build one cache entry: the (uniform, breed, refs) triple.
 
@@ -468,11 +480,25 @@ def _build_programs(
 
     def run_children(seeds, rows):
         view = sweep(seeds, rows)
-        ok = jnp.asarray(invariant(view), jnp.bool_)
-        if ok.shape != seeds.shape:
-            raise ValueError(
-                f"invariant must return a {seeds.shape} boolean array, "
-                f"got shape {ok.shape}"
+        if invariant is not None:
+            ok = jnp.asarray(invariant(view), jnp.bool_)
+            if ok.shape != seeds.shape:
+                raise ValueError(
+                    f"invariant must return a {seeds.shape} boolean "
+                    f"array, got shape {ok.shape}"
+                )
+        else:
+            ok = jnp.ones(seeds.shape, jnp.bool_)
+        if history_check is not None:
+            # the device history screen, traced WITH the sweep into the
+            # generation program: verdicts fold into the violation mask
+            # right where the histories were recorded — per-seed
+            # history columns never leave the device
+            from ..check.device import screen_ok as _screen_ok
+
+            ok = ok & _screen_ok(
+                history_check, view["hist_word"], view["hist_t"],
+                view["hist_count"], view["hist_drop"],
             )
         if require_halt:
             ok = ok & view["halted"]
@@ -733,15 +759,25 @@ def run_device(
     mesh=None,
     viol_cap: int | None = None,
     pool_index: bool | None = None,
+    history_check=None,
 ) -> ExploreReport:
     """Run one exploration campaign with every generation device-resident.
 
     Same contract and bit-identical outcomes as :func:`explore.run`
     (module docstring), with these differences:
 
-    * ``invariant`` is REQUIRED and must be jnp-traceable over the final
-      state view (``{field: array} -> (S,) bool``) — it runs inside the
-      device program. ``history_invariant`` hunts need the host driver.
+    * ``invariant`` must be jnp-traceable over the final state view
+      (``{field: array} -> (S,) bool``) — it runs inside the device
+      program. ``history_check`` (a ``check.device.HistoryScreen`` or
+      tuple) is the device form of a ``history_invariant`` hunt: the
+      batch detectors trace into the cached generation program (the
+      screen tuple is a ``_GEN_CACHE`` key component) and their
+      verdicts mark violations exactly like the host driver running
+      ``check.device.screens_invariant(history_check)`` — the two
+      campaigns are bit-identical, and a device find replays/shrinks
+      on the host driver through that same invariant. At least one of
+      the two must be given; arbitrary host-side ``history_invariant``
+      callables still need the host driver.
     * ``mesh`` (a ``parallel.make_mesh`` Mesh) shards mutation and the
       sweep across chips with ``shard_map``; ``batch`` must divide over
       the device count. Sharded and unsharded campaigns are identical.
@@ -769,11 +805,21 @@ def run_device(
     """
     if isinstance(space, FaultPlan):
         space = PlanSpace(space)
-    if invariant is None:
+    if history_check is not None:
+        from ..check.device import as_screens
+
+        history_check = as_screens(history_check)
+        if wl.history is None:
+            raise ValueError(
+                f"history_check judges operation histories, but workload "
+                f"{wl.name!r} has Workload.history=None"
+            )
+    if invariant is None and history_check is None:
         raise ValueError(
-            "run_device needs a traceable final-state invariant (it is "
-            "evaluated inside the device program); history_invariant "
-            "checkers run host-side — use explore.run for those hunts"
+            "run_device needs a traceable final-state invariant and/or a "
+            "history_check screen set (both run inside the device "
+            "program); arbitrary host-side history_invariant callables "
+            "need the host driver — use explore.run for those hunts"
         )
     if cov_words < 1:
         raise ValueError("exploration needs cov_words >= 1 (the guidance)")
@@ -883,6 +929,11 @@ def run_device(
         max_ops, float(inherit_seed_p), bool(cov_hitcount), bool(metrics),
         latency, _mesh_key(mesh), tuple(lp.hash() for lp in seed_corpus),
         pool_index,
+        # invariant identity of the device history screen: screens are
+        # value-hashable literals, so equal screen sets share programs
+        # across campaigns (the ROADMAP "invariant identity" key
+        # component)
+        history_check,
     )
     prog_uniform, prog_breed = _gen_programs(
         key,
@@ -894,6 +945,7 @@ def run_device(
             inherit_seed_p=inherit_seed_p, cov_hitcount=cov_hitcount,
             metrics=metrics, latency=latency, mesh=mesh,
             seed_corpus=seed_corpus, cache_key=key, pool_index=pool_index,
+            history_check=history_check,
         ),
     )
 

@@ -35,6 +35,7 @@ jaxpr matrix.
 from .taint import TaintEqn, TaintResult, analyze_jaxpr  # noqa: F401
 from .noninterference import (  # noqa: F401
     CAMPAIGN_AXES,
+    CHECK_AXES,
     FLIGHT_AXES,
     NonInterferenceReport,
     check_matrix,
@@ -57,6 +58,7 @@ __all__ = [
     "TaintResult",
     "analyze_jaxpr",
     "CAMPAIGN_AXES",
+    "CHECK_AXES",
     "FLIGHT_AXES",
     "NonInterferenceReport",
     "check_matrix",

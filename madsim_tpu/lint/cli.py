@@ -46,6 +46,7 @@ def main(argv=None) -> int:
     if args.jaxpr:
         from .noninterference import (
             BUILD_AXES,
+            CHECK_AXES,
             LAYOUT_AXES,
             check_matrix,
             model_matrix,
@@ -69,6 +70,15 @@ def main(argv=None) -> int:
         # never traced
         reports = check_matrix(
             models, {"all": BUILD_AXES["all"]}, layouts=LAYOUT_AXES
+        )
+        # the device-verification boundary smoke (ISSUE 14): the
+        # history-recording models with the check.device detector
+        # kernels traced WITH the sim through the shard_map boundary —
+        # taint set unchanged, verdict output only, no callback prims
+        # (the full matrix row runs in tools/lint_soak.py)
+        check_models = [m for m in models if m[0] in ("raft/record",)]
+        reports += check_matrix(
+            check_models, CHECK_AXES, entry="sharded_run"
         )
 
     if args.json:

@@ -50,6 +50,7 @@ __all__ = [
     "plant_met_leak",
     "BUILD_AXES",
     "CAMPAIGN_AXES",
+    "CHECK_AXES",
     "FLIGHT_AXES",
     "LAYOUT_AXES",
 ]
@@ -184,6 +185,7 @@ def check_noninterference(
     n_seeds: int = 2,
     mutate=None,
     flight: bool = False,
+    check: bool = False,
 ) -> NonInterferenceReport:
     """Prove (or refute) derived-state non-interference for one build.
 
@@ -206,6 +208,18 @@ def check_noninterference(
     still isolated). Every report also carries ``callback_prims``: any
     host round-trip primitive found in the traced program fails the
     proof regardless of taint.
+
+    ``check=True`` appends the device history detectors
+    (``check.device.default_screens`` over the final state's history
+    columns) to the traced program — the device-verification boundary
+    proof: the detector kernels are traced WITH the sim (through the
+    ``shard_map`` boundary under ``entry="sharded_run"``, the
+    ``explore.run_device`` history-hunt program shape), and the proof
+    obligations are that the taint set is UNCHANGED (the detectors
+    read derived history columns and write only the new ``check_ok``
+    output — never a core column) and that no host-callback primitive
+    appears. Needs a run-shaped entry (the detectors judge batched
+    final states).
     """
     flags = dict(
         layout=layout, time32=time32, placement=placement, dup_rows=dup_rows,
@@ -222,6 +236,29 @@ def check_noninterference(
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
         latency=latency,
     )
+    if check:
+        if entry == "step":
+            raise ValueError(
+                "check=True traces the batch detectors over a RUN's "
+                "final states; use entry='run' or 'sharded_run'"
+            )
+        from ..check.device import default_screens
+        from ..check.device import screen_ok as _screen_ok
+
+        flags["check"] = True
+        _screens = default_screens()
+
+        def _with_check(base):
+            def checked(st):
+                out = base(st)
+                return out, _screen_ok(
+                    _screens, out.hist_word, out.hist_t, out.hist_count,
+                    out.hist_drop,
+                )
+            return checked
+    else:
+        def _with_check(base):
+            return base
     init = make_init(
         wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
@@ -235,10 +272,10 @@ def check_noninterference(
         )
         template = jax.tree.map(lambda a: a[0], state)
     elif entry == "run":
-        fn = make_run(
+        fn = _with_check(make_run(
             wl, cfg, n_steps, layout=layout, time32=time32,
             placement=placement, pool_index=pool_index, **obs_kw,
-        )
+        ))
         template = state
     elif entry == "sharded_run":
         # the multi-chip campaign program (explore.run_device's simulate
@@ -258,11 +295,14 @@ def check_noninterference(
         if rows % n_dev:
             rows += n_dev - rows % n_dev
         state = init(np.zeros(rows, np.uint64))
-        run_fn = make_run(
+        run_fn = _with_check(make_run(
             wl, cfg, n_steps, layout=layout, time32=time32,
             placement=placement, pool_index=pool_index, **obs_kw,
-        )
+        ))
         spec = _P(mesh.axis_names)
+        # the detector (when check=True) is INSIDE the shard_map body:
+        # the per-shard program is sim + screen, exactly how
+        # explore.run_device composes them
         fn = _par.shard_map_nocheck(
             run_fn, mesh, in_specs=spec, out_specs=spec
         )
@@ -290,8 +330,20 @@ def check_noninterference(
         closed, out_shape = jax.make_jaxpr(fn, return_shape=True)(template)
     in_names = _leaf_names(template)
     out_names = _leaf_names(out_shape)
+    if check:
+        # the checked entry returns (state, verdict): strip the tuple
+        # prefix from the state leaves and name the verdict leaf — it
+        # is ALLOWED to carry history taint (that is what a verdict
+        # is); a core column newly tainted is still the leak
+        out_names = [
+            "check_ok" if n.startswith("[1]")
+            else n.removeprefix("[0]").lstrip(".")
+            for n in out_names
+        ]
     derived = derived_fields(wl)
     dset = set(derived)
+    if check:
+        dset.add("check_ok")
     in_taints = [
         frozenset({name}) if name in dset else frozenset()
         for name in in_names
@@ -451,6 +503,20 @@ FLIGHT_AXES = {
         cov_words=8, metrics=True, latency=LatencySpec(ops=8, phases=2),
         flight=True,
     ),
+}
+
+# The device-verification entry (ISSUE 14): the history-hunt program
+# shape — sim + the check.device detector kernels in ONE traced
+# program, proved through the shard_map boundary
+# (``check_matrix(models, CHECK_AXES, entry="sharded_run")``; the
+# tier-1 smoke uses entry="run"). Obligations: the detectors read the
+# derived history columns and write ONLY the new ``check_ok`` verdict
+# output (taint set unchanged — no derived value reaches a core
+# column through the detector arithmetic), and no host-callback
+# primitive joins the program (the detectors are a lowering of the
+# numpy checkers, not a host bridge).
+CHECK_AXES = {
+    "device-check": dict(cov_words=8, metrics=True, check=True),
 }
 
 def model_matrix() -> list:

@@ -109,6 +109,7 @@ def make_raftlog(
     record: bool = False,
     bug: str | None = None,
     army: bool = False,
+    cov_spread: bool = False,
 ) -> Workload:
     """``record=True`` turns on operation-history recording
     (madsim_tpu.check): every election win records an ``OP_ELECT`` event
@@ -174,7 +175,16 @@ def make_raftlog(
     while the probed server is up, so the measured RTT isolates the
     *transport and scheduling* tail (gray-failure slow links, pause
     storms) from leader-election availability. Probes to a dead server
-    never complete — incomplete ops ARE the unavailability signal."""
+    never complete — incomplete ops ARE the unavailability signal.
+
+    ``cov_spread=True`` contributes protocol-specific coverage
+    features (``Workload.cov_features``): the fleet's commit-index
+    spread (max - min over the servers) and the (floor, spread) pair —
+    the guidance signal for history hunts, where the interesting
+    schedules are the ones that drag replicas' commit points apart (a
+    wide spread is exactly the window a lost write or a recovery
+    regression hides in). Coverage-only: traces and verdicts are
+    bit-identical with it on or off."""
     if bug not in (None, "nosync"):
         raise ValueError(f"unknown raftlog bug {bug!r} (only 'nosync')")
     if bug and not durable:
@@ -563,6 +573,27 @@ def make_raftlog(
     if army:
         handler_names += ("areq", "aprobe", "aresp")
         handlers += (on_areq, on_aprobe, on_aresp)
+
+    def _commit_spread(ns, now):
+        # servers only (the army client's row never holds a commit
+        # index); spread as its own feature word, plus the (floor,
+        # spread) pair so the SAME spread at a new commit floor still
+        # reads as fresh behavior. Both fields masked to their 8-bit
+        # lanes: commit indices are bounded by n_writes but n_writes is
+        # caller-chosen, and an overflowing floor must alias other
+        # (floor, spread) pairs — never the discriminator bit or the
+        # bare-spread word
+        c = ns[:n_nodes, COMMIT]
+        lo = jnp.min(c).astype(jnp.uint32)
+        spread = (jnp.max(c).astype(jnp.uint32)) - lo
+        m8 = jnp.uint32(0xFF)
+        return (
+            (spread, jnp.bool_(True)),
+            ((lo & m8) | ((spread & m8) << jnp.uint32(8))
+             | jnp.uint32(1 << 16),
+             jnp.bool_(True)),
+        )
+
     return Workload(
         name="raftlog"
         + ("-nosync" if bug == "nosync" else "")
@@ -589,6 +620,7 @@ def make_raftlog(
         # two-phase sync discipline over exactly those columns: a kill
         # keeps them only up to the node's last EmitBuilder.sync
         durable_sync=durable,
+        cov_features=_commit_spread if cov_spread else None,
         # capacity sizing: elections are a handful per run even under
         # chaos; commit records total w plus re-commits after leader
         # changes (a new leader re-records the indices it re-confirms).

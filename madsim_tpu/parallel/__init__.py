@@ -74,6 +74,7 @@ __all__ = [
     "merge_coverage",
     "merge_latency",
     "merge_metrics",
+    "merge_verdicts",
     "seed_sharding",
     "shard_map_nocheck",
     "shard_state",
@@ -240,6 +241,43 @@ def merge_latency(lat_hist, mesh: Mesh | None = None) -> np.ndarray:
     return np.asarray(per_dev, np.int64).sum(axis=0)
 
 
+def merge_verdicts(ok, mesh: Mesh | None = None) -> np.ndarray:
+    """Pack per-seed verdicts (S,) bool into (S/32,) uint32 words.
+
+    The verdict analog of :func:`merge_metrics`: with a ``mesh``, each
+    device packs its LOCAL seed shard's verdicts
+    (``check.device.pack_verdicts`` under ``shard_map``, zero
+    cross-device traffic — seed shards are contiguous, so the word
+    arrays concatenate in seed order) and only S/32 words reach the
+    host — a 65k-seed sweep's history verdicts cost 2 KiB of transfer.
+    Seeds must split over the devices in multiples of 32 (word
+    alignment); unpack host-side with
+    ``check.device.unpack_verdicts``.
+    """
+    import jax.numpy as jnp
+
+    from ..check.device import pack_verdicts
+
+    okb = jnp.asarray(ok, jnp.bool_)
+    if okb.ndim != 1:
+        raise ValueError(f"ok must be (S,), got shape {okb.shape}")
+    if mesh is None:
+        return np.asarray(jax.jit(pack_verdicts)(okb))
+    n_dev = mesh.devices.size
+    local = okb.shape[0] // n_dev if n_dev else 0
+    if n_dev == 0 or okb.shape[0] % n_dev or local % 32:
+        raise ValueError(
+            f"{okb.shape[0]} verdicts do not split over {n_dev} devices "
+            f"in word-aligned (multiple-of-32) shards"
+        )
+    spec = P(mesh.axis_names)
+    per_dev = jax.jit(
+        _shard_map(pack_verdicts, mesh=mesh, in_specs=spec, out_specs=spec,
+                   **_SM_NOCHECK)
+    )(okb)
+    return np.asarray(per_dev, np.uint32)
+
+
 def shard_run_compacted(
     wl,
     cfg,
@@ -251,6 +289,7 @@ def shard_run_compacted(
     min_size: int = 2048,
     fields: tuple | None = None,
     latency=None,
+    hist_screen=None,
 ):
     """Multi-chip form of :func:`engine.make_run_compacted`.
 
@@ -267,13 +306,20 @@ def shard_run_compacted(
     numpy arrays, like the single-device runner. ``state`` should be
     placed with :func:`shard_state` (an unsharded state works too — jit
     reshards it to the declared input sharding).
+
+    ``hist_screen`` runs the device history detectors + prefix-
+    compaction at bank time PER DEVICE (the ``make_run_compacted``
+    contract, inside ``shard_map``): each chip screens and folds its
+    own banked rows with zero cross-device traffic, and the assembled
+    host result carries the same ``hist_ok``/``hist_fold`` columns —
+    bit-identical to the unsharded screened runner.
     """
     from ..engine import compact as _compact
 
     kw = {} if fields is None else {"fields": fields}
     base = _compact.make_run_compacted(
         wl, cfg, max_steps, layout, time32, shrink=shrink,
-        min_size=min_size, latency=latency, **kw,
+        min_size=min_size, latency=latency, hist_screen=hist_screen, **kw,
     )
     n_dev = mesh.devices.size
     spec = P(mesh.axis_names)

@@ -224,6 +224,30 @@ class TestNonInterference:
         # the proof walked INTO the mapped body, not around it
         assert any("shard_map" in r["path"] for r in rep.frontier)
 
+    def test_check_axes_device_verification_row(self):
+        """check=True traces the check.device detector kernels WITH the
+        sim through shard_map (the history-hunt program shape): taint
+        set unchanged, the verdict output carries ONLY history taint,
+        no callback prims, and step entries are rejected."""
+        from madsim_tpu.lint import CHECK_AXES
+
+        flags = dict(CHECK_AXES["device-check"])
+        rep = check_noninterference(
+            make_raft(record=True), CFG, entry="sharded_run",
+            n_seeds=4, n_steps=3, **flags,
+        )
+        assert rep.ok, rep.summary()
+        assert rep.flags["check"] is True
+        # the verdict is tainted by the history columns and nothing else
+        assert set(rep.out_taint["check_ok"]) <= {
+            "hist_word", "hist_t", "hist_count", "hist_drop"
+        }
+        assert not rep.callback_prims
+        with pytest.raises(ValueError, match="entry"):
+            check_noninterference(
+                make_raft(record=True), CFG, entry="step", check=True,
+            )
+
     def test_sharded_run_planted_leak_is_caught(self):
         # the positive control crosses the call boundary: met comes out
         # of the shard_map'd run and leaks into the RNG cursor — the

@@ -60,6 +60,7 @@ from madsim_tpu.lint import (  # noqa: E402
 from madsim_tpu.lint.noninterference import (  # noqa: E402
     BUILD_AXES,
     CAMPAIGN_AXES,
+    CHECK_AXES,
     FLIGHT_AXES,
     LAYOUT_AXES,
 )
@@ -104,6 +105,17 @@ def main() -> None:
         log=lambda s: print(f"  {s}"),
     )
     bad += [r for r in flight_reports if not r.ok]
+    # the device-verification row (ISSUE 14): every model with the
+    # check.device detector kernels traced WITH the sim through the
+    # shard_map boundary — the explore.run_device history-hunt program
+    # shape. Proof obligations: the detectors touch only the derived
+    # history columns and the new check_ok verdict output (taint set
+    # unchanged), and the program stays host-callback-free
+    check_reports = check_matrix(
+        axes=CHECK_AXES, entry="sharded_run",
+        log=lambda s: print(f"  {s}"),
+    )
+    bad += [r for r in check_reports if not r.ok]
     if bad:
         failures.append("noninterference")
         for r in bad:

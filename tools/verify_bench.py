@@ -1,0 +1,308 @@
+"""Device-resident verification A/B: history-verified sweeps with the
+detectors on device vs the host numpy path. The VERIFY evidence
+artifact (ISSUE 14).
+
+Four certificates:
+
+1. **Verdict identity + fold accounting** — ``search_seeds(
+   device_check=...)`` produces bit-identical per-seed verdicts to the
+   numpy ``history_invariant`` path (``check.device.
+   screens_invariant``) on the kvchaos record model, clean AND planted
+   lost-write mutant, across the lockstep and the compacted
+   (prefix-compacting) runner; and the fold is loud and lossless:
+   original count == hist_count + hist_fold per seed, flagged seeds'
+   columns verbatim equal to the unscreened runner's.
+2. **Same-box interleaved A/B: the history-verified campaign.** The
+   host driver was the ONLY path for ``history_invariant`` hunts
+   (ROADMAP item 1); the device driver now runs them end-to-end with
+   the detectors traced into the generation program. The SAME guided
+   history hunt (kvchaos record, stale/lost-write + read-your-writes +
+   monotonic-reads screens) runs alternately on both drivers,
+   interleaved rounds, bit-identical campaign outcomes asserted. The
+   certificate: device ≥ 3x host generations/s at ≥65k seeds per
+   generation with history invariants on (warm-up round reported, not
+   scored — the campaign_bench discipline: on this box only the A/B
+   ratio means anything, never absolutes). The generation-program
+   cache is profiler-certified across the rounds: retraces == 1 per
+   (key, mode) including the new screen key component.
+3. **Transfer bytes + verification wall** — verification's
+   host-transfer payload at A/B scale, from the array shapes that
+   actually cross: the numpy path moves the full history columns
+   (word + t + count + drop); the device path moves ceil(S/32) verdict
+   words plus the *flagged* seeds' full histories (the Wing–Gong
+   escalation input). Certificate: ≥ 10x reduction on the mutant sweep
+   (real flags — no free lunch from a clean batch). The wall split
+   (sim-only / +numpy detectors / +device screen) prints alongside.
+4. **Find path** — a smaller (4096 seeds/gen) device history hunt on
+   the mutant finds the lost write, outcomes identical to the host
+   driver, the find replays to its recorded trace + verdict through
+   the host driver's replay path, and the flagged seed's escalated
+   full history fails exact Wing–Gong KV linearizability (the PR-1
+   cross-check: vectorized catches are exact-confirmed).
+
+The A/B horizon is short (the campaign_bench argument: on this CPU
+"device" the sim step is ~2 orders slower than accelerator silicon, so
+a long horizon buries the driver+verification overhead both arms share
+the sim for).
+
+Usage: python tools/verify_bench.py [batch] [gens] [rounds] > VERIFY_r09.txt
+       python tools/verify_bench.py --smoke   (lean `make check` gate:
+           identity + fold + bytes accounting + a tiny A/B, no floors)
+Defaults: batch 65536, gens 4, rounds 2 (+1 warm-up).
+Exit 0 iff every certificate holds (throughput/bytes floors skipped
+under --smoke).
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore  # noqa: E402
+from madsim_tpu.chaos import CrashStorm, FaultPlan  # noqa: E402
+from madsim_tpu.chaos.plan import stack_plan_rows  # noqa: E402
+from madsim_tpu.check import device as dcheck  # noqa: E402
+from madsim_tpu.check.linearize import check_kv  # noqa: E402
+from madsim_tpu.engine import EngineConfig, make_init, search_seeds  # noqa: E402
+from madsim_tpu.engine.compact import make_run_compacted  # noqa: E402
+from madsim_tpu.models import make_kvchaos  # noqa: E402
+from madsim_tpu.obs import prof  # noqa: E402
+
+CFG = EngineConfig(pool_size=40, loss_p=0.02,
+                   clog_backoff_max_ns=2_000_000_000)
+SCREENS = (
+    dcheck.stale_reads(),
+    dcheck.read_your_writes(),
+    dcheck.monotonic_reads(),
+)
+HOST_INV = dcheck.screens_invariant(SCREENS)
+PLAN = FaultPlan(
+    (CrashStorm(targets=(1, 2, 3, 4), n=2, t_min_ns=20_000_000,
+                t_max_ns=400_000_000, down_min_ns=50_000_000,
+                down_max_ns=250_000_000),),
+    name="verify-bench",
+)
+WRITES = 5
+MAX_STEPS = 96
+COV_WORDS = 32
+
+
+def _fingerprint(rep):
+    return (
+        [(e.id, e.generation, e.parent, e.seed, e.plan.hash(), e.trace,
+          e.new_bits) for e in rep.corpus],
+        rep.cov_map.tolist(),
+        [(e.seed, e.trace) for e in rep.violations],
+        rep.curve,
+        rep.viol_curve,
+    )
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv[1:]
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if smoke:
+        batch = int(args[0]) if args else 2048
+        gens, rounds = 2, 1
+    else:
+        batch = int(args[0]) if args else 65536
+        gens = int(args[1]) if len(args) > 1 else 4
+        rounds = int(args[2]) if len(args) > 2 else 2
+    failures = []
+    t_all = time.monotonic()  # lint: allow(wall-clock)
+    print(f"# verify bench: batch {batch}, {gens} generations, "
+          f"{rounds} timed rounds (+1 warm-up), smoke={smoke}, "
+          f"platform={jax.devices()[0].platform}")
+    print(f"# kvchaos writes={WRITES} record, plan {PLAN.hash()}, "
+          f"max_steps {MAX_STEPS}, screens "
+          f"{'+'.join(s.kind for s in SCREENS)}")
+
+    wl_clean = make_kvchaos(writes=WRITES, record=True)
+    wl_bug = make_kvchaos(writes=WRITES, record=True, bug=True)
+
+    # ---- certificate 1: verdict identity + fold accounting ----
+    print("== cert 1: device == numpy verdicts, lockstep + compact ==")
+    id_seeds = min(batch, 8192)
+    id_ok = True
+    for wl, tag in ((wl_clean, "clean"), (wl_bug, "mutant")):
+        kw = dict(n_seeds=id_seeds, max_steps=600, require_halt=False)
+        host = search_seeds(wl, CFG, None, history_invariant=HOST_INV, **kw)
+        dev = search_seeds(wl, CFG, None, device_check=SCREENS, **kw)
+        cmp_ = search_seeds(wl, CFG, None, device_check=SCREENS,
+                            compact=True, **kw)
+        same = (np.array_equal(host.ok, dev.ok)
+                and np.array_equal(host.ok, cmp_.ok))
+        # the fold is loud and lossless: screened vs unscreened
+        # compacted runs of the identical batch
+        fseeds = np.arange(min(id_seeds, 2048), dtype=np.uint64)
+        init = make_init(wl, CFG)
+        plain = make_run_compacted(wl, CFG, 600)(init(fseeds))
+        folded = make_run_compacted(wl, CFG, 600, hist_screen=SCREENS)(
+            init(fseeds)
+        )
+        fold_ok = np.array_equal(
+            folded.hist_count + folded.hist_fold, plain.hist_count
+        )
+        flagged_rows = ~folded.hist_ok
+        fold_ok = fold_ok and np.array_equal(
+            folded.hist_word[flagged_rows], plain.hist_word[flagged_rows]
+        ) and np.array_equal(
+            folded.hist_t[flagged_rows], plain.hist_t[flagged_rows]
+        )
+        frac = (
+            folded.hist_fold.sum() / max(plain.hist_count.sum(), 1)
+        )
+        print(f"  {tag}: {id_seeds} seeds, verdicts identical={same}, "
+              f"fold lossless={fold_ok} "
+              f"({frac:.0%} of records folded before transfer), "
+              f"{len(dev.failing_seeds)} violations, "
+              f"{len(dev.flagged_idx)} flagged -> escalated")
+        id_ok = id_ok and same and fold_ok
+    if not id_ok:
+        failures.append("verdict-identity")
+    print(f"cert1 {'PASS' if id_ok else 'FAIL'}")
+
+    # ---- certificate 3: bytes + verification wall at A/B scale ----
+    print("== cert 3: verification wall + host-transfer bytes ==")
+    kw = dict(n_seeds=batch, max_steps=600, require_halt=False)
+    search_seeds(wl_bug, CFG, None, device_check=SCREENS, **kw)  # warm
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    search_seeds(wl_bug, CFG, lambda v: np.ones(batch, bool), **kw)
+    w_sim = time.monotonic() - t0  # lint: allow(wall-clock)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    search_seeds(wl_bug, CFG, None, history_invariant=HOST_INV, **kw)
+    w_host = time.monotonic() - t0  # lint: allow(wall-clock)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    dev = search_seeds(wl_bug, CFG, None, device_check=SCREENS, **kw)
+    w_dev = time.monotonic() - t0  # lint: allow(wall-clock)
+    hcap = wl_bug.history.capacity
+    host_bytes = batch * hcap * 5 * 4 + batch * hcap * 8 + 2 * batch * 4
+    fl = len(dev.flagged_idx)
+    # the device path still materializes the per-seed hist_count +
+    # hist_drop counters host-side (the overflow quarantine reads
+    # them), so they count against it — only the big word/t columns
+    # are replaced by verdict words + flagged rows
+    dev_bytes = (
+        dev.verdict_words.nbytes + 2 * batch * 4
+        + fl * (hcap * 5 * 4 + hcap * 8 + 2 * 4)
+    )
+    ratio_b = host_bytes / max(dev_bytes, 1)
+    print(f"  wall: sim-only {w_sim:.2f}s | +numpy detectors "
+          f"{w_host:.2f}s | +device screen {w_dev:.2f}s")
+    print(f"  bytes/sweep: full columns {host_bytes / 1e6:.1f} MB vs "
+          f"{dev.verdict_words.nbytes} B verdict words + {fl} flagged "
+          f"histories = {dev_bytes / 1e6:.3f} MB -> "
+          f"{ratio_b:.0f}x reduction")
+    bytes_ok = smoke or ratio_b >= 10.0
+    if not bytes_ok:
+        failures.append("bytes-below-10x")
+    print(f"cert3 {'PASS' if bytes_ok else 'FAIL'}")
+
+    # ---- certificate 2: interleaved A/B, history-verified campaign ----
+    print("== cert 2: interleaved A/B, host vs device history hunt ==")
+    kw = dict(generations=gens, batch=batch, root_seed=7,
+              max_steps=MAX_STEPS, cov_words=COV_WORDS)
+    fps = []
+    walls = {"host": [], "device": []}
+    profiler = prof.ProgramProfiler()
+    for r in range(rounds + 1):
+        tag = "warmup " if r == 0 else f"round {r}"
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        rep_h = explore.run(
+            wl_clean, CFG, PLAN, invariant=None,
+            history_invariant=HOST_INV, **kw,
+        )
+        wh = time.monotonic() - t0  # lint: allow(wall-clock)
+        with prof.profiled(profiler):
+            t0 = time.monotonic()  # lint: allow(wall-clock)
+            rep_d = explore.run_device(
+                wl_clean, CFG, PLAN, invariant=None,
+                history_check=SCREENS, **kw,
+            )
+            wd = time.monotonic() - t0  # lint: allow(wall-clock)
+        fps += [_fingerprint(rep_h), _fingerprint(rep_d)]
+        print(f"  {tag}: host {wh:7.1f}s ({gens / wh:.3f} gens/s) | "
+              f"device {wd:6.1f}s ({gens / wd:.3f} gens/s) | "
+              f"ratio {wh / wd:.2f}x")
+        if r > 0:
+            walls["host"].append(wh)
+            walls["device"].append(wd)
+    med_h = statistics.median(walls["host"])
+    med_d = statistics.median(walls["device"])
+    ratio = med_h / med_d
+    identical = all(f == fps[0] for f in fps[1:])
+    retr = profiler.retraces("explore.device")
+    retrace_ok = bool(retr) and all(v == 1 for v in retr.values())
+    print(f"  medians: host {med_h:.1f}s vs device {med_d:.1f}s -> "
+          f"device {ratio:.2f}x generations/s with history screens on")
+    print(f"  outcomes identical across {len(fps)} runs: {identical} | "
+          f"_GEN_CACHE retraces == 1 per key over {rounds + 1} device "
+          f"campaigns: {retrace_ok} {dict(retr)}")
+    ab_ok = identical and retrace_ok and (smoke or ratio >= 3.0)
+    if not identical:
+        failures.append("outcomes-not-bit-identical")
+    if not retrace_ok:
+        failures.append("gen-cache-retraced")
+    if not smoke and ratio < 3.0:
+        failures.append("device-below-3x")
+    print(f"cert2 {'PASS' if ab_ok else 'FAIL'}")
+
+    # ---- certificate 4: the find path at 4096 seeds/gen ----
+    print("== cert 4: device history hunt finds the lost write ==")
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    fkw = dict(generations=3, batch=min(batch, 4096), root_seed=7,
+               max_steps=600, cov_words=COV_WORDS)
+    rep_h = explore.run(wl_bug, CFG, PLAN, invariant=None,
+                        history_invariant=HOST_INV, **fkw)
+    rep_d = explore.run_device(wl_bug, CFG, PLAN, invariant=None,
+                               history_check=SCREENS, **fkw)
+    v_same = _fingerprint(rep_h) == _fingerprint(rep_d)
+    found = bool(rep_d.violations)
+    replay_ok = exact_ok = False
+    if found:
+        e = rep_d.violations[0]
+        r = explore.replay_entry(wl_bug, CFG, e,
+                                 history_invariant=HOST_INV,
+                                 max_steps=600)
+        replay_ok = (int(r.traces[0]) == e.trace and not bool(r.ok[0]))
+        # escalation: rerun the entry under the device screen; the
+        # flagged seed's FULL history must fail exact Wing-Gong KV
+        # linearizability too
+        dev_rep = search_seeds(
+            wl_bug, CFG, None, seeds=np.asarray([e.seed], np.uint64),
+            plan_rows=stack_plan_rows([e.plan]),
+            dup_rows=e.plan.uses_dup(), device_check=SCREENS,
+            max_steps=600, require_halt=False,
+        )
+        fh = dev_rep.flagged_history
+        exact_ok = (
+            fh is not None and len(fh) == 1 and not check_kv(fh.ops(0)).ok
+        )
+    print(f"  host {len(rep_h.violations)} == device "
+          f"{len(rep_d.violations)} violations, identical {v_same}, "
+          f"found {found}, host-driver replay {replay_ok}, "
+          f"Wing-Gong escalation confirms {exact_ok} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+    find_ok = v_same and found and replay_ok and exact_ok
+    if not find_ok:
+        failures.append("find-path")
+    print(f"cert4 {'PASS' if find_ok else 'FAIL'}")
+
+    dt = time.monotonic() - t_all  # lint: allow(wall-clock)
+    print(f"# verify bench: {'PASS' if not failures else 'FAIL'} "
+          f"({dt:.0f}s)"
+          f"{' failures=' + ','.join(failures) if failures else ''}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

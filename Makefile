@@ -25,7 +25,7 @@ SHELL   := /bin/bash
 
 .PHONY: check check-full native test test-full tier1 determinism \
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
-        store-soak latency-soak lint lint-soak profile clean \
+        store-soak latency-soak lint lint-soak absint-soak profile clean \
         campaign-bench flight pool-bench pool-bench-smoke \
         verify-bench verify-bench-smoke
 
@@ -41,12 +41,25 @@ check-full: native lint test-full determinism bench-smoke flight \
 # nondeterminism-leak linter (fails on any new finding; intentional
 # real-mode sites carry checked `# lint: allow(rule)` pragmas) plus a
 # jaxpr non-interference smoke (raft/record + raftlog/durable, all obs
-# taps on). The full model x config matrix is `make lint-soak`.
+# taps on) plus the interval-prover smoke (`--absint`: overflow + lane
+# disjointness on raft/record across the lowering sweep, absint pragma
+# staleness checked). `--format json` gives the machine-readable form
+# for CI gating. The full model x config matrices are `make lint-soak`
+# and `make absint-soak`.
 lint:
-	JAX_PLATFORMS=cpu $(PY) -m madsim_tpu.lint --jaxpr
+	JAX_PLATFORMS=cpu $(PY) -m madsim_tpu.lint --jaxpr --absint
 
 lint-soak:
 	$(PY) tools/lint_soak.py
+
+# Interval-prover soak (madsim_tpu/lint/absint.py): the full overflow
+# + threefry-lane matrix (models x axes x LAYOUT_AXES, step AND run
+# entries), both planted mutants (time32 sentinel decay, lane
+# collision) caught with cited chains, pragma hygiene, lane census.
+# The ABSINT_r10.txt evidence artifact.
+absint-soak:
+	JAX_PLATFORMS=cpu $(PY) tools/absint_soak.py > ABSINT_r10.txt; rc=$$?; \
+	    cat ABSINT_r10.txt; exit $$rc
 
 # Per-config step profile (tools/profile_step.py): phase wall
 # breakdown by ablation differencing + XLA's HLO cost analysis, one

@@ -57,10 +57,12 @@ from ..engine.core import (
     KIND_UNCLOG_1W,
     KIND_UNSLOW,
     PlanRows,
+    SLOW_MULT_MAX,
     pack_slow_arg,
     unpack_slow_arg,
 )
 from ..engine.rng import (
+    DRAW_SPAN_MAX,
     PURPOSE_CLIENT,
     PURPOSE_PLAN,
     chance_threshold,
@@ -261,12 +263,14 @@ def _check_window(lo: int, hi: int, what: str) -> None:
     if not 0 <= lo <= hi:
         raise ValueError(f"{what} window [{lo}, {hi}] is invalid")
     # draws are 32-bit (the engine's reduction discipline): a span that
-    # doesn't fit uint32 would wrap/overflow in _Stream.uniform — same
-    # constraint EngineConfig enforces on its latency ranges
-    if hi - lo >= (1 << 32):
+    # doesn't fit uint32 would wrap/overflow in _Stream.uniform — the
+    # same DRAW_SPAN_MAX contract EngineConfig enforces on its latency
+    # ranges and the absint range contracts assume (engine/rng.py owns
+    # the constant, so this validator and the prover cannot drift)
+    if hi - lo > DRAW_SPAN_MAX:
         raise ValueError(
             f"{what} span {hi - lo} ns does not fit uint32 "
-            f"(max {(1 << 32) - 1} ns, ~4.29 s)"
+            f"(max {DRAW_SPAN_MAX} ns, ~4.29 s)"
         )
 
 
@@ -556,8 +560,13 @@ class GrayFailure:
             raise ValueError(
                 f"multiplier range [{self.mult_min}, {self.mult_max}] invalid"
             )
-        if self.mult_max >= (1 << 23):
-            raise ValueError("multiplier must fit the packed args word (<2^23)")
+        if self.mult_max > SLOW_MULT_MAX:
+            # engine.SLOW_MULT_MAX owns the packed-args-word bound AND
+            # the absint slow-column range contract: one declaration
+            raise ValueError(
+                f"multiplier must fit the packed args word "
+                f"(engine.SLOW_MULT_MAX = {SLOW_MULT_MAX})"
+            )
         _check_window(self.t_min_ns, self.t_max_ns, "slow-time")
         _check_window(self.dur_min_ns, self.dur_max_ns, "slow-duration")
 
@@ -669,8 +678,11 @@ class ClockSkew:
             raise ValueError(f"n must be >= 1, got {self.n}")
         if self.skew_min_ns > self.skew_max_ns:
             raise ValueError("skew range is empty")
-        # strict lower bound: the span (max+1 - min) must also fit the
-        # uint32 draw reduction, which -2^31..2^31-1 would overflow
+        # strict lower bound: skews land in the int32 skew column AND
+        # the span (max+1 - min) must fit the uint32 draw reduction —
+        # the ±(2^31 - 1) bound makes the maximal inclusive span
+        # exactly DRAW_SPAN_MAX (the shared engine/rng.py contract),
+        # so this one check enforces both
         lim = 2**31
         if not (-lim < self.skew_min_ns and self.skew_max_ns < lim):
             raise ValueError("skew must fit int32 nanoseconds (~±2.1 s)")

@@ -66,7 +66,13 @@ from .core import (  # noqa: E402,F401
     lat_bucket,
     lat_bucket_hi,
     lat_bucket_lo,
+    ABSINT_COUNTER_MAX,
+    ABSINT_HORIZON_NS,
+    ABSINT_STEP_MAX,
+    ColumnContract,
+    SLOW_MULT_MAX,
     build_pool_index,
+    column_contracts,
     core_fields,
     derived_fields,
     pool_index_eligible,
@@ -87,8 +93,13 @@ from .checkpoint import save as save_checkpoint  # noqa: E402,F401
 from .search import SearchReport, make_sweep, search_seeds  # noqa: E402,F401
 from .replay import ReplayEvent, format_timeline, refold, replay  # noqa: E402,F401
 from .rng import (  # noqa: E402,F401
+    DRAW_SPAN_MAX,
+    PURPOSE_LANES,
     Draw,
+    PurposeLane,
     chance_threshold,
+    lane_of,
     np_threefry2x32,
     threefry2x32,
+    validate_user_purposes,
 )

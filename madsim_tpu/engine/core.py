@@ -62,6 +62,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .rng import (
+    DRAW_SPAN_MAX,
     PURPOSE_DUP,
     PURPOSE_LATENCY,
     PURPOSE_LOSS,
@@ -70,6 +71,7 @@ from .rng import (
     PURPOSE_USER,
     Draw,
     chance_threshold,
+    validate_user_purposes,
 )
 
 __all__ = [
@@ -129,6 +131,12 @@ __all__ = [
     "POOL_INDEX_STATE_FIELDS",
     "derived_fields",
     "core_fields",
+    "ColumnContract",
+    "column_contracts",
+    "ABSINT_HORIZON_NS",
+    "ABSINT_COUNTER_MAX",
+    "ABSINT_STEP_MAX",
+    "SLOW_MULT_MAX",
     "pool_tile",
     "pool_index_eligible",
     "resolve_layout",
@@ -631,6 +639,187 @@ def core_fields(wl: "Workload") -> tuple:
     )
 
 
+# ---------------------------------------------------------------------------
+# Column range contracts (lint.absint). Each SimState column declares
+# the integer range its values occupy at step boundaries, under the
+# certification horizon — the assumptions the interval abstract
+# interpreter seeds its walk with, and the vocabulary its findings
+# cite. Two tracked families:
+#   "time"    — virtual-clock values (absolute int64 ns, or int32
+#               offsets under time32). Bounded by the horizon plus the
+#               largest insertion offset; under time32, pool columns
+#               span the full int32 range because STALE slot offsets
+#               keep rebasing after their slot is consumed and may wrap
+#               (masked at every use — the per-site pragma'd
+#               subtractions in make_step are exactly these).
+#   "counter" — monotone/capacity-bounded counts (event sequence
+#               number, overflow/drop tallies, history/timeline fills,
+#               metrics). Bounded by capacity where one exists, else by
+#               ABSINT_COUNTER_MAX (the certified run-length budget).
+# Untracked columns (hashes, RNG seeds, workload state words, packed
+# meta) get their full dtype range and no family: arithmetic on them is
+# either intentionally modular (unsigned hashes/ciphers) or
+# workload-defined (node_state), neither a time32/counter wraparound
+# surface.
+# ---------------------------------------------------------------------------
+
+# Default certification horizon: the largest virtual clock the prover
+# certifies arithmetic under when the config declares no time_limit_ns.
+# 2^42 ns ~ 73 sim-minutes — an order of magnitude past every recorded
+# run shape (bench runs sim seconds to minutes); models declare their
+# own (smaller) horizons via absint_entries().
+ABSINT_HORIZON_NS = 1 << 42
+# Certified bound on unbounded counters (cumulative drops, msg counts,
+# metrics): a run is certified for at most this many counted events.
+ABSINT_COUNTER_MAX = 1 << 30
+# Certified bound on the event sequence number (the RNG step
+# coordinate, uint32): one instance is certified for this many steps.
+ABSINT_STEP_MAX = 1 << 31
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnContract:
+    """Declared value range of one SimState column at step boundaries."""
+
+    field: str
+    lo: int
+    hi: int
+    family: str | None = None  # "time" | "counter" | None (untracked)
+    note: str = ""
+
+
+def _dtype_full(dt) -> tuple:
+    info = np.iinfo(dt)
+    return int(info.min), int(info.max)
+
+
+def column_contracts(
+    wl: "Workload",
+    cfg: "EngineConfig",
+    *,
+    time32: bool = False,
+    horizon_ns: int | None = None,
+) -> dict:
+    """The per-column range contracts for one (workload, config) build.
+
+    ``horizon_ns`` is the certification horizon (default: the config's
+    ``time_limit_ns`` when set, else :data:`ABSINT_HORIZON_NS`). The
+    returned dict maps field name -> :class:`ColumnContract` and is
+    TOTAL over SimState: every field must be declared here (an
+    untracked column declares its full dtype range with no family) —
+    a new column missing from the list raises, because silently
+    defaulting would weaken the proof without anyone deciding so.
+    """
+    if horizon_ns is None:
+        horizon_ns = cfg.time_limit_ns or ABSINT_HORIZON_NS
+    h = int(horizon_ns)
+    cnt = ABSINT_COUNTER_MAX
+    i32 = _dtype_full(np.int32)
+    i64 = _dtype_full(np.int64)
+    u32 = _dtype_full(np.uint32)
+    u64 = _dtype_full(np.uint64)
+    # the largest offset one insertion can put on the pool clock: a
+    # handler timer (declared bound, else the horizon itself), a
+    # slow-scaled latency draw, or a clog-backoff reschedule (+ <1 us
+    # jitter) — the same terms time32_eligible bounds
+    delay_hi = wl.delay_bound_ns if wl.delay_bound_ns is not None else h
+    offset_hi = max(
+        int(delay_hi),
+        int(cfg.lat_max_ns) * SLOW_MULT_MAX,
+        int(cfg.clog_backoff_max_ns) + 1_000,
+    )
+    hcap = wl.history.capacity if wl.history is not None else 0
+
+    def c(field, lo, hi, family=None, note=""):
+        return ColumnContract(field, int(lo), int(hi), family, note)
+
+    if time32:
+        # offsets from `now`; valid slots are bounded by the insertion
+        # clamp (lim32), but stale slots rebase forever and may wrap —
+        # the honest contract is the full dtype range (family still
+        # "time": any NEW arithmetic on these columns is a wrap surface
+        # unless its site is individually annotated)
+        ev_time = c("ev_time", *i32, "time", "int32 offsets; stale may wrap")
+        tile_min = c("tile_min", *i32, "time", "empty tiles = +inf sentinel")
+    else:
+        ev_time = c("ev_time", 0, h + offset_hi, "time", "absolute ns")
+        tile_min = c(
+            "tile_min", 0, int(_INF_NS), "time", "empty tiles = +inf sentinel"
+        )
+    out = [
+        c("seed", *u64),
+        c("now", 0, h, "time"),
+        c("step", 0, ABSINT_STEP_MAX, "counter", "RNG step coordinate"),
+        c("halted", 0, 1),
+        c("halt_time", 0, h, "time"),
+        c("trace", *u64, None, "rolling hash, modular by design"),
+        c("overflow", 0, cnt, "counter"),
+        c("msg_count", 0, cnt, "counter"),
+        ev_time,
+        c("ev_valid", 0, 1),
+        c("ev_meta", *u32, None, "packed kind/node/src/retry bytes"),
+        c("ev_epoch", -1, cnt, "counter", "-1 = ANY-epoch sentinel"),
+        c("ev_args", *i32),
+        c("ev_pay", *i32),
+        c("alive", 0, 1),
+        c("paused", 0, 1),
+        c("epoch", 0, cnt, "counter"),
+        c("node_state", *i32, None, "workload-defined words"),
+        c("clog", 0, 1),
+        c("slow", 0, SLOW_MULT_MAX, None, "link latency multiplier"),
+        c("dup", 0, 1),
+        c("skew", *i32, None, "per-node clock skew ns"),
+        c("disk", *i32),
+        c("wmask", 0, 1),
+        c("sync_loss", 0, 1),
+        c("sync_eio", 0, 1),
+        c("torn", 0, 1),
+        c("hist_count", 0, max(hcap, 0), "counter"),
+        c("hist_drop", 0, cnt, "counter"),
+        c("hist_word", *i32),
+        c("hist_t", 0, h, "time"),
+        c("cov", *u32, None, "bitmap words, modular folds"),
+        c("cov_last", -1, 255),
+        c("cov_hits", 0, 255),
+        c("met", 0, cnt, "counter"),
+        c("tl_count", 0, cnt, "counter"),
+        c("tl_drop", 0, cnt, "counter"),
+        c("tl_t", 0, h, "time"),
+        c("tl_meta", *u32),
+        c("tl_args", *i32),
+        c("tl_pay", *i32),
+        c("ev_emit", 0, h, "time"),
+        c("tl_emit", 0, h, "time"),
+        c("lat_inv", -1, h, "time", "-1 = never invoked"),
+        c("lat_resp", -1, h, "time", "-1 = incomplete"),
+        c("lat_hist", 0, cnt, "counter"),
+        c("lat_count", 0, cnt, "counter"),
+        c("lat_drop", 0, cnt, "counter"),
+        tile_min,
+        c("tile_cnt", 0, max(pool_tile(cfg.pool_size), 64), "counter"),
+    ]
+    contracts = {cc.field: cc for cc in out}
+    missing = [
+        f.name for f in dataclasses.fields(SimState)
+        if f.name not in contracts
+    ]
+    if missing:
+        # a new SimState column without a declared contract would
+        # silently weaken the proof (full-range, untracked)
+        raise AssertionError(
+            f"column_contracts is missing SimState fields: {missing}"
+        )
+    return contracts
+
+
+# Largest slow-link latency multiplier the packed args word can carry:
+# pack_slow_arg stores the multiplier in bits 8..30 of an int32. The
+# chaos plan validator (chaos/plan.py GrayFailure) enforces it at spec
+# build time and the absint range contracts (column_contracts) assume
+# it — one declaration, so the validator and the prover cannot drift.
+SLOW_MULT_MAX = (1 << 23) - 1
+
+
 def pack_slow_arg(b, mult):
     """Pack a slow-link peer + multiplier into one int32 args word:
     low byte = peer node + 1 (0 = node-wide), bits 8.. = multiplier.
@@ -688,10 +877,10 @@ class EngineConfig:
         ):
             if hi < lo:
                 raise ValueError(f"{what} range [{lo}, {hi}) is empty")
-            if hi - lo >= (1 << 32):
+            if hi - lo > DRAW_SPAN_MAX:
                 raise ValueError(
                     f"{what} span {hi - lo} ns does not fit uint32 "
-                    f"(max {(1 << 32) - 1} ns, ~4.29 s)"
+                    f"(max {DRAW_SPAN_MAX} ns, ~4.29 s)"
                 )
 
     @property
@@ -1228,19 +1417,14 @@ class Workload:
                 f"lat_markers must be >= 0, got {self.lat_markers}"
             )
         if self.draw_purposes is not None:
-            bad = [
-                p for p in self.draw_purposes
-                if not 0 <= int(p) < (1 << 32) - PURPOSE_USER
-            ]
-            if bad:
-                raise ValueError(
-                    f"draw_purposes {bad} out of the user purpose range "
-                    f"[0, 2^32 - {PURPOSE_USER})"
-                )
-            if len(set(self.draw_purposes)) != len(self.draw_purposes):
-                raise ValueError(
-                    f"draw_purposes has duplicates: {self.draw_purposes}"
-                )
+            # validated against the structured lane registry: before
+            # PURPOSE_LANES, any purpose below 2^32 - PURPOSE_USER was
+            # accepted and an out-of-range user lane silently aliased
+            # the plan/explore/client high blocks. The error now names
+            # the lane the purpose would collide with.
+            validate_user_purposes(
+                self.draw_purposes, what="Workload.draw_purposes"
+            )
         if self.handler_names is not None and len(self.handler_names) != len(
             self.handlers
         ):
@@ -2248,9 +2432,17 @@ def make_step(
             # and the backoff offset needs no correction. Stale offsets
             # in invalid slots may wrap; they are masked at every use.
             adv32 = (now_after - st.now).astype(jnp.int32)
-            ev_time_reb = st.ev_time - adv32
+            # The two rebase subtractions below are the acknowledged
+            # stale-slot wrap surface: a consumed slot's offset keeps
+            # rebasing and may wrap int32 after ~2.1 sim-seconds —
+            # masked at every use (ev_valid), and relationally bounded
+            # for VALID slots (a valid offset is >= the popped minimum,
+            # so it never drops below -proc_max), which a non-relational
+            # interval domain cannot see. Certified instead by the
+            # layout bit-identity pins (tests/test_engine.py).
+            ev_time_reb = st.ev_time - adv32  # lint: allow(absint-overflow)
             back_t = backoff.astype(jnp.int32)
-            old_t = ev_time_i - adv32
+            old_t = ev_time_i - adv32  # lint: allow(absint-overflow)
         else:
             ev_time_reb = st.ev_time
             back_t = now + backoff
@@ -2285,7 +2477,10 @@ def make_step(
             # into its tile's count. The popped tile's MIN is
             # recomputed exactly after placement (a consume can RAISE
             # it, which no incremental min update can express).
-            tile_min_mid = (st.tile_min - adv32) if time32 else st.tile_min
+            # (same stale-wrap surface as the pool rebase above: empty
+            # tiles' carried sentinels decay here and are re-masked to
+            # +inf before any min-fold reads them — the PR-13 rule)
+            tile_min_mid = (st.tile_min - adv32) if time32 else st.tile_min  # lint: allow(absint-overflow)
             tile_cnt_mid = st.tile_cnt.at[wtile].add(
                 resched.astype(jnp.int32) - has_event.astype(jnp.int32)
             )

@@ -25,15 +25,27 @@ Draw discipline (mirrored by engine/core.py and the oracle):
   counter = (event_step, purpose)                     # per-draw
   value   = threefry2x32(key, counter)[0]             # 32 uniform bits
 
-``purpose`` namespaces the draws made while processing one event: engine
-purposes live in [0, 128) (poll cost, per-emit latency/loss, clog
-backoff), user handler purposes in [128, 2^32).
+``purpose`` namespaces the draws made while processing one event. The
+namespace is a structured registry — :data:`PURPOSE_LANES` — of named
+``(base, width, owner)`` blocks: engine lanes in [0, 128) (poll cost,
+per-emit latency/loss, dup shadows, torn prefix), user handler lanes in
+[128, plan-base), and the host-side plan/explore/client blocks at
+``0x9E37xxxx``+. Two draw sites resolving into the same lane slot at
+the same counter read the SAME cipher value, so lane disjointness is a
+checked invariant: ``Workload.draw_purposes`` is validated against the
+registry at build time (:func:`validate_user_purposes`) and the
+interval prover (``lint.absint``) proves every traced program's live
+sites pairwise disjoint under :func:`lane_site_tracing`.
 """
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 __all__ = [
@@ -41,6 +53,14 @@ __all__ = [
     "np_threefry2x32",
     "np_threefry2x32v",
     "Draw",
+    "PurposeLane",
+    "PURPOSE_LANES",
+    "lane",
+    "lane_of",
+    "validate_user_purposes",
+    "lane_site_tracing",
+    "LANE_SITE_NAME",
+    "DRAW_SPAN_MAX",
     "PURPOSE_POLL_COST",
     "PURPOSE_LATENCY",
     "PURPOSE_LOSS",
@@ -57,66 +77,177 @@ _ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
 # Skein key-schedule parity constant for 32-bit words.
 _PARITY = np.uint32(0x1BD11BDA)
 
-# Engine purpose namespace. One event-step makes at most one draw per
+# The one range contract of the modulo reduction: every bounded draw
+# (Draw.uniform_int, the chaos plan streams, EngineConfig's latency and
+# processing-cost windows) reduces 32 uniform bits by `bits % span`, so
+# a span wider than this silently wraps and skews the distribution.
+# EngineConfig, chaos window validation and the absint range contracts
+# (lint.absint / engine.column_contracts) all derive from THIS constant
+# so the validators and the prover cannot drift.
+DRAW_SPAN_MAX = (1 << 32) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PurposeLane:
+    """One declared block of the threefry purpose namespace.
+
+    The purpose word namespaces every draw made at one ``(seed, step)``
+    counter; a lane is a contiguous block of purposes with ONE owner.
+    Two draw sites that resolve into the same lane slot at the same
+    counter read the SAME cipher value — silently correlated streams —
+    so the registry's pairwise disjointness is a checked invariant
+    (``lint.absint.check_ranges`` proves it per traced program, and
+    :func:`validate_user_purposes` rejects user lanes that would alias
+    an engine block at build time).
+    """
+
+    name: str
+    base: int
+    width: int  # number of purpose values in the lane
+    owner: str  # "engine" | "user" | "chaos" | "explore"
+    note: str = ""
+
+    @property
+    def end(self) -> int:
+        """Exclusive upper bound of the lane."""
+        return self.base + self.width
+
+    def __contains__(self, purpose) -> bool:
+        return self.base <= int(purpose) < self.end
+
+    def describe(self) -> str:
+        return (
+            f"{self.name}[{self.base:#x}..{self.end:#x}) "
+            f"owner={self.owner}"
+        )
+
+
+# The structured purpose registry — THE declaration of who owns which
+# purposes (previously comment-partitioned constants; MIGRATING.md
+# documents the change). One event-step makes at most one draw per
 # purpose, so (seed, step, purpose) uniquely keys every draw in a run.
-# ONE block at PURPOSE_POLL_COST yields both the per-event processing
-# cost (lane 0, 50-100 ns) and the clogged-link recheck jitter (lane 1)
-# via Draw.bits2 — the same pairing the per-emit latency/loss draws use.
-PURPOSE_POLL_COST = 0
-# reserved/legacy: the engine no longer draws a separate block here (the
-# jitter rides PURPOSE_POLL_COST lane 1), but the purpose id stays
-# unavailable so old and new layouts never alias.
-PURPOSE_CLOG_JITTER = 1
-# torn-write prefix draw (madsim_tpu.chaos disk faults): when a KILL
-# lands on a node whose torn-write mode is armed, ONE block at this
-# purpose picks how many columns of the last uncommitted durable write
-# survive the crash. Only drawn when the step is built for a
-# Workload.durable_sync workload; counter-addressed like every other
-# purpose, so enabling the discipline never shifts any other draw.
-PURPOSE_TORN = 2
-# per-emit-slot draws: ONE block at PURPOSE_LATENCY+s yields both the
-# latency (lane 0) and loss (lane 1) words via Draw.bits2. PURPOSE_LOSS
-# is reserved/legacy space: the engine no longer draws there, but the
-# range stays unavailable to callers so old and new layouts never alias.
-PURPOSE_LATENCY = 8  # + emit slot  (8 .. 8+K), both lanes used
-PURPOSE_LOSS = 64  # legacy per-slot loss range, re-purposed: see PURPOSE_DUP
-# duplicated-delivery draws (chaos KIND_DUP_ON, engine/core.py dup_rows):
-# shadow emit slot s draws its independent latency/loss pair at
-# PURPOSE_DUP+s. This re-uses the retired per-slot loss range — no
-# current layout draws there, and max_emits <= 55 keeps PURPOSE_DUP+s
-# below PURPOSE_USER.
-PURPOSE_DUP = PURPOSE_LOSS
-PURPOSE_USER = 128  # + user purpose
+# Gaps between lanes are unassigned: a draw resolving there is a bug
+# the lane prover reports. Engine lanes:
+#   poll_cost  — ONE block yields both the per-event processing cost
+#                (lane 0, 50-100 ns) and the clogged-link recheck
+#                jitter (lane 1) via Draw.bits2.
+#   clog_jitter — reserved/legacy: the jitter rides poll_cost lane 1
+#                now, but the id stays unavailable so old and new
+#                layouts never alias.
+#   torn       — torn-write prefix draw (chaos disk faults): when a
+#                KILL lands on a node whose torn-write mode is armed,
+#                ONE block picks how many columns of the last
+#                uncommitted durable write survive. Only drawn for
+#                Workload.durable_sync workloads; counter-addressed, so
+#                enabling the discipline never shifts any other draw.
+#   latency    — per-emit-slot draws at base+slot: latency (lane 0)
+#                and loss (lane 1) from one block (Draw.bits2).
+#   dup        — duplicated-delivery draws (chaos KIND_DUP_ON): shadow
+#                emit slot s draws its independent latency/loss pair at
+#                base+s. Re-uses the retired per-slot loss range
+#                (PURPOSE_LOSS) — no current layout draws there, and
+#                max_emits <= 55 keeps latency slots below this base.
+# Host-side lanes (draws keyed by plan/batch slot, not the step
+# counter; each sits far above every in-simulation purpose):
+#   plan       — fault-plan compilation (chaos.FaultPlan), x0 = draw
+#                index, x1 = base + plan slot; slots stay below 64k.
+#   explore    — exploration seed/mutation derivation (explore), x1 =
+#                base + batch slot; slots below 64k.
+#   client     — open-loop client-army arrival generation
+#                (chaos.ClientArmy), x1 = base + plan slot: arrivals
+#                are pool rows compiled from coordinates, so offered
+#                load is a pure function of the seed whatever
+#                trajectory the faults push the protocol onto.
+PURPOSE_LANES = (
+    PurposeLane("poll_cost", 0, 1, "engine", "cost lane 0 / jitter lane 1"),
+    PurposeLane("clog_jitter", 1, 1, "engine", "reserved/legacy"),
+    PurposeLane("torn", 2, 1, "engine", "torn-write prefix draw"),
+    PurposeLane("latency", 8, 56, "engine", "base+slot, lat/loss pair"),
+    PurposeLane("dup", 64, 64, "engine", "base+slot, dup shadow pair"),
+    PurposeLane("user", 128, 0x9E370000 - 128, "user", "base+user purpose"),
+    PurposeLane("plan", 0x9E370000, 1 << 16, "chaos", "base+plan slot"),
+    PurposeLane("explore", 0x9E380000, 1 << 16, "explore", "base+batch slot"),
+    PurposeLane("client", 0x9E390000, 1 << 16, "chaos", "base+plan slot"),
+)
 
-# Fault-plan compilation (madsim_tpu.chaos) also draws from this
-# threefry keyed by the instance seed, but host-side with counter
-# x0 = draw index, x1 = PURPOSE_PLAN + plan slot. PURPOSE_PLAN sits far
-# above any purpose the engine or in-repo handlers use, so plan draws
-# can never alias an in-simulation draw at the same (seed, step) — each
-# (seed, plan-slot) pair is its own reproducible stream (the BatchRNG
-# varying-parameter-stream shape).
-PURPOSE_PLAN = 0x9E370000
 
-# Coverage-guided exploration (madsim_tpu.explore) derives fresh child
-# seeds and mutation draws from the campaign's ROOT seed with counter
-# x1 = PURPOSE_EXPLORE + batch-slot. Plan slots stay below 64k, so
-# PURPOSE_PLAN + slot < PURPOSE_EXPLORE — the two host-side streams can
-# never alias each other (and both sit far above every in-simulation
-# purpose).
-PURPOSE_EXPLORE = 0x9E380000
+def _validate_registry(lanes) -> None:
+    prev_end = 0
+    for ln in lanes:
+        if ln.width < 1 or ln.base < prev_end or ln.end > (1 << 32):
+            raise ValueError(
+                f"PURPOSE_LANES registry corrupt at {ln.describe()}: lanes "
+                f"must be non-empty, sorted and pairwise disjoint in uint32"
+            )
+        prev_end = ln.end
 
-# Open-loop client-army arrival generation (madsim_tpu.chaos
-# ClientArmy): arrival times and per-op argument words are threefry
-# draws keyed (seed, PURPOSE_CLIENT + plan slot) — one reproducible
-# stream per (seed, op), the BatchRNG varying-parameter-stream shape
-# again. Because arrivals are pool rows compiled from coordinates (not
-# in-simulation draws at a step counter), the offered load is a pure
-# function of the seed: the SAME arrival schedule hits the protocol
-# whatever trajectory the faults push it onto — the open-loop property
-# that makes tail latency measurable. Explore's batch slots stay below
-# 64k, so PURPOSE_EXPLORE + slot < PURPOSE_CLIENT keeps the host-side
-# streams disjoint.
-PURPOSE_CLIENT = 0x9E390000
+
+_validate_registry(PURPOSE_LANES)
+
+
+def lane(name: str) -> PurposeLane:
+    """The registered lane called ``name`` (KeyError if unknown)."""
+    for ln in PURPOSE_LANES:
+        if ln.name == name:
+            return ln
+    raise KeyError(f"no purpose lane named {name!r}")
+
+
+def lane_of(purpose: int) -> PurposeLane | None:
+    """The lane containing ``purpose``, or None for unassigned space."""
+    for ln in PURPOSE_LANES:
+        if purpose in ln:
+            return ln
+    return None
+
+
+def validate_user_purposes(purposes, what: str = "draw_purposes") -> None:
+    """Reject user purposes that leave the ``user`` lane.
+
+    ``purposes`` are USER-relative (the ints handlers pass to
+    ``ctx.draw.user`` / ``Draw.user``, i.e. offsets above
+    ``PURPOSE_USER``). Before the registry, any value below
+    ``2^32 - PURPOSE_USER`` was accepted — an out-of-range user lane
+    silently aliased the plan/explore/client blocks (same cipher
+    value, correlated "independent" streams). Now the error names the
+    lane the purpose would collide with.
+    """
+    ulane = lane("user")
+    seen = set()
+    for p in purposes:
+        p = int(p)
+        # the raw offset must fit the lane BEFORE any uint32 wrap: a
+        # purpose >= 2^32 would wrap back onto a small lane at draw
+        # time (Draw.user casts to uint32) and bit-for-bit duplicate
+        # its stream — reject on the unwrapped value
+        if not 0 <= p < ulane.width:
+            absolute = (ulane.base + p) % (1 << 32)
+            hit = lane_of(absolute)
+            where = hit.describe() if hit is not None else "unassigned space"
+            raise ValueError(
+                f"{what} purpose {p} is outside the user lane "
+                f"[0, {ulane.width:#x}) — at draw time it would resolve "
+                f"to absolute purpose {absolute:#x} and alias {where}, "
+                f"silently correlating the streams "
+                f"(engine/rng.py PURPOSE_LANES)"
+            )
+        if p in seen:
+            raise ValueError(f"{what} has duplicates: purpose {p}")
+        seen.add(p)
+
+
+# Backward-compatible purpose constants, now DERIVED from the registry
+# (the bases are the contract; the registry is the declaration).
+PURPOSE_POLL_COST = lane("poll_cost").base
+PURPOSE_CLOG_JITTER = lane("clog_jitter").base
+PURPOSE_TORN = lane("torn").base
+PURPOSE_LATENCY = lane("latency").base  # + emit slot, both lanes used
+PURPOSE_DUP = lane("dup").base  # + shadow emit slot
+PURPOSE_LOSS = PURPOSE_DUP  # legacy alias: the retired per-slot loss range
+PURPOSE_USER = lane("user").base  # + user purpose
+PURPOSE_PLAN = lane("plan").base  # + plan slot (host-side)
+PURPOSE_EXPLORE = lane("explore").base  # + batch slot (host-side)
+PURPOSE_CLIENT = lane("client").base  # + plan slot (host-side)
 
 
 def _rotl32(x, r: int):
@@ -124,16 +255,38 @@ def _rotl32(x, r: int):
     return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
 
 
-def threefry2x32(k0, k1, x0, x1):
-    """Threefry-2x32, 20 rounds. All inputs/outputs are uint32 arrays.
+# ---------------------------------------------------------------------------
+# Lane-site tracing (lint.absint). A traced simulation program inlines
+# every cipher application into ~50 anonymous uint32 rounds, which makes
+# the (counter, purpose) operands of each DRAW SITE invisible to jaxpr
+# analyses. Under this context manager, threefry2x32 routes through a
+# named jit boundary instead: each cipher application then appears in
+# the jaxpr as ONE ``pjit[name=threefry2x32_lane_site]`` equation whose
+# third/fourth operands are the (x0, x1) counter words — exactly what
+# the lane-disjointness prover resolves against PURPOSE_LANES. The jit
+# wraps the identical round function, so values are bit-identical;
+# production tracing never takes this path (zero cost, zero program
+# change outside an analysis trace).
+# ---------------------------------------------------------------------------
+LANE_SITE_NAME = "threefry2x32_lane_site"
+_LANE_SITE_DEPTH = 0
+_SITE_JIT = None  # built lazily (jax.jit at import would eager-init jax)
 
-    Pure jnp integer ops: identical bit patterns on CPU and TPU backends,
-    which is what makes batched-vs-oracle traces exactly comparable.
-    """
-    k0 = jnp.asarray(k0, jnp.uint32)
-    k1 = jnp.asarray(k1, jnp.uint32)
-    x0 = jnp.asarray(x0, jnp.uint32)
-    x1 = jnp.asarray(x1, jnp.uint32)
+
+@contextlib.contextmanager
+def lane_site_tracing():
+    """Trace-time context: make every threefry call a named jaxpr site."""
+    global _LANE_SITE_DEPTH
+    _LANE_SITE_DEPTH += 1
+    try:
+        yield
+    finally:
+        _LANE_SITE_DEPTH -= 1
+
+
+def threefry2x32_lane_site(k0, k1, x0, x1):
+    """The 20 Threefry rounds (uint32 in/out) — the body both the plain
+    and the lane-site path run; the name is the jaxpr site marker."""
     ks = (k0, k1, k0 ^ k1 ^ jnp.uint32(_PARITY))
     x0 = x0 + ks[0]
     x1 = x1 + ks[1]
@@ -148,11 +301,29 @@ def threefry2x32(k0, k1, x0, x1):
     return x0, x1
 
 
+def threefry2x32(k0, k1, x0, x1):
+    """Threefry-2x32, 20 rounds. All inputs/outputs are uint32 arrays.
+
+    Pure jnp integer ops: identical bit patterns on CPU and TPU backends,
+    which is what makes batched-vs-oracle traces exactly comparable.
+    """
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    x0 = jnp.asarray(x0, jnp.uint32)
+    x1 = jnp.asarray(x1, jnp.uint32)
+    if _LANE_SITE_DEPTH:
+        global _SITE_JIT
+        if _SITE_JIT is None:
+            _SITE_JIT = jax.jit(threefry2x32_lane_site)
+        return _SITE_JIT(k0, k1, x0, x1)
+    return threefry2x32_lane_site(k0, k1, x0, x1)
+
+
 def np_threefry2x32(k0, k1, x0, x1):
     """Numpy mirror of :func:`threefry2x32` — the oracle's generator.
 
-    Kept textually parallel to the jnp version on purpose; any divergence
-    is a bug the trace-compare tests will catch.
+    Kept textually parallel to the jnp rounds (threefry2x32_lane_site)
+    on purpose; any divergence is a bug the trace-compare tests catch.
     """
     k0 = np.uint32(k0)
     k1 = np.uint32(k1)

@@ -18,6 +18,19 @@ turns them into *checked invariants* at analysis time:
   frontier per equation, and for any leak the offending equation chain
   plus the source/destination column names (the same names
   ``obs.explain`` prints).
+* :func:`check_ranges` (lint.absint) — a forward interval abstract
+  interpreter over the same jaxprs: per-var integer ranges seeded from
+  the SimState column contracts (``engine.column_contracts``), walked
+  through scan/while fixpoints with widening. Two provers ride the
+  walk: overflow certification (no signed add/sub/mul on a time- or
+  counter-tainted value may exceed its dtype within the declared
+  horizon — the time32 wraparound bug class) and threefry lane
+  disjointness (every draw site's (purpose, counter) operands resolved
+  against the structured ``engine.rng.PURPOSE_LANES`` registry, all
+  live lanes pairwise disjoint — the correlated-streams bug class).
+  Findings honor the same checked ``# lint: allow(absint-*)`` pragma
+  allowlist; ``absint_matrix`` sweeps the recorded-model x lowering
+  matrix.
 * :func:`lint_paths` / :func:`lint_repo` — an AST linter over sim code
   flagging intercept-bypassing calls (wall clocks, ambient entropy,
   ``uuid``, un-threefry'd ``np.random``), unordered-set iteration in
@@ -33,6 +46,20 @@ jaxpr matrix.
 """
 
 from .taint import TaintEqn, TaintResult, analyze_jaxpr  # noqa: F401
+from .absint import (  # noqa: F401
+    ABSINT_AXES,
+    AbsintReport,
+    absint_matrix,
+    absint_model_matrix,
+    absint_pragma_inventory,
+    analyze_intervals,
+    check_lane_sites,
+    check_ranges,
+    plant_lane_collision,
+    plant_time32_sentinel_decay,
+    run_mutant_controls,
+    stale_absint_pragmas,
+)
 from .noninterference import (  # noqa: F401
     CAMPAIGN_AXES,
     CHECK_AXES,
@@ -57,6 +84,18 @@ __all__ = [
     "TaintEqn",
     "TaintResult",
     "analyze_jaxpr",
+    "ABSINT_AXES",
+    "AbsintReport",
+    "absint_matrix",
+    "absint_model_matrix",
+    "absint_pragma_inventory",
+    "analyze_intervals",
+    "check_lane_sites",
+    "check_ranges",
+    "plant_lane_collision",
+    "plant_time32_sentinel_decay",
+    "run_mutant_controls",
+    "stale_absint_pragmas",
     "CAMPAIGN_AXES",
     "CHECK_AXES",
     "FLIGHT_AXES",

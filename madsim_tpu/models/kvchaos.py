@@ -477,3 +477,18 @@ def lint_entries():
         ("kvchaos/record", make_kvchaos(record=True, payload=True), kw),
         ("kvchaos/army", make_kvchaos(army=True), kw),
     ]
+
+
+# Declared interval-certification horizon (lint.absint): client-army
+# load windows span sim-seconds; 300 sim-seconds is generous slack
+# over every recorded kvchaos hunt shape.
+ABSINT_HORIZON_NS = 300 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): lint_entries rows plus the declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

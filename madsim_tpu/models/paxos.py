@@ -344,3 +344,18 @@ def lint_entries():
         ("paxos/plain", make_paxos(), kw),
         ("paxos/record", make_paxos(record=True), kw),
     ]
+
+
+# Declared interval-certification horizon (lint.absint): a ballot
+# settles within sim-seconds; 60 sim-seconds covers every recorded
+# paxos run shape with an order of magnitude of slack.
+ABSINT_HORIZON_NS = 60 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): lint_entries rows plus the declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

@@ -180,3 +180,20 @@ def lint_entries():
         ("raft/plain", make_raft(), kw),
         ("raft/record", make_raft(record=True), kw),
     ]
+
+
+# Declared interval-certification horizon (lint.absint): elections
+# resolve within sim-seconds; 60 sim-seconds is an order of magnitude
+# of slack over every recorded raft run shape.
+ABSINT_HORIZON_NS = 60 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): ``(tag, workload, engine-config kwargs,
+    certification horizon ns)`` — lint_entries plus the model's
+    declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

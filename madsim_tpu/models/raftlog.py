@@ -687,3 +687,18 @@ def lint_entries():
         ("raftlog/durable", make_raftlog(durable=True, record=True), kw),
         ("raftlog/army", make_raftlog(army=True), kw),
     ]
+
+
+# Declared interval-certification horizon (lint.absint): chaos soaks
+# replicate for sim-minutes; 300 sim-seconds covers every recorded
+# raftlog campaign shape with room.
+ABSINT_HORIZON_NS = 300 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): lint_entries rows plus the declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

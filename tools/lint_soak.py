@@ -38,6 +38,11 @@ Four certificates:
    allowlist — a stale pragma is itself a finding).
 4. **Rule fixtures** — every linter rule fires on a canonical negative
    fixture (the linter's own positive control).
+5. **Interval-prover smoke** — the absint overflow + lane proofs on
+   raft/record across the full lowering sweep, both planted mutants
+   (time32 sentinel decay, lane collision) caught with cited chains.
+   The FULL absint matrix is its own artifact (tools/absint_soak.py,
+   `make absint-soak`).
 
 Usage: python tools/lint_soak.py > LINT_r11.txt
 Exit 0 iff every certificate holds.
@@ -245,6 +250,34 @@ def main() -> None:
     if not rules_ok:
         failures.append("rule-fixtures")
     print(f"cert4 {'PASS' if rules_ok else 'FAIL'}")
+
+    # ---- certificate 5: interval-prover smoke (absint) ----
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    print("== cert 5: absint overflow + lane smoke (full matrix: "
+          "make absint-soak) ==")
+    from madsim_tpu.lint import (
+        ABSINT_AXES,
+        absint_matrix,
+        absint_model_matrix,
+        run_mutant_controls,
+    )
+
+    amodels = [m for m in absint_model_matrix() if m[0] == "raft/record"]
+    areps = absint_matrix(
+        amodels, {"all": ABSINT_AXES["all"]}, layouts=LAYOUT_AXES,
+        log=lambda s: print(f"  {s}"),
+    )
+    abad = [r for r in areps if not r.ok]
+    controls = run_mutant_controls()
+    mut_ok = all(caught for _n, _r, caught in controls)
+    for name, _rep, caught in controls:
+        print(f"  {name} mutant caught: {caught}")
+    if abad or not mut_ok:
+        failures.append("absint")
+        for r in abad:
+            print(r.summary())
+    print(f"cert5 {'PASS' if not abad and mut_ok else 'FAIL'} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
 
     print(f"# done in {time.monotonic() - t_all:.0f}s wall")  # lint: allow(wall-clock)
     if failures:

@@ -27,14 +27,14 @@ SHELL   := /bin/bash
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
         store-soak latency-soak lint lint-soak absint-soak profile clean \
         campaign-bench flight pool-bench pool-bench-smoke \
-        verify-bench verify-bench-smoke
+        verify-bench verify-bench-smoke farm farm-smoke
 
 check: native lint test determinism bench-smoke flight pool-bench-smoke \
-       verify-bench-smoke
+       verify-bench-smoke farm-smoke
 	@echo "== make check: all gates passed =="
 
 check-full: native lint test-full determinism bench-smoke flight \
-            pool-bench-smoke verify-bench-smoke
+            pool-bench-smoke verify-bench-smoke farm-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -112,6 +112,24 @@ verify-bench:
 
 verify-bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/verify_bench.py --smoke
+
+# Fuzzing-farm soak (madsim_tpu/farm/, ISSUE 16): the pipelined-vs-
+# blocking device-driver A/B (organic + loaded telemetry-drain
+# regimes, bit-identical corpus/coverage/violations and byte-equal
+# checkpoints, host_syncs 1/gen; floors 1.25x — organic gated on
+# multi-core boxes, loaded everywhere), the 3-tenant scheduled session
+# (standalone-equal splices, profiler-certified retraces == 1, tagged
+# telemetry), adaptive-energy >= uniform at equal budget on the
+# kvchaos mutant (aggregated over 3 roots at the needle shape), and
+# the energy-off bit-identity row. The FARM_r11.txt evidence artifact;
+# the smoke (tiny sizes, identity certs only, no floors) rides
+# `make check`.
+farm:
+	$(PY) tools/farm_soak.py > FARM_r11.txt; rc=$$?; \
+	    cat FARM_r11.txt; exit $$rc
+
+farm-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/farm_soak.py --smoke
 
 native:
 	$(MAKE) -C native

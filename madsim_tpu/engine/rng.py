@@ -69,6 +69,7 @@ __all__ = [
     "PURPOSE_PLAN",
     "PURPOSE_EXPLORE",
     "PURPOSE_CLIENT",
+    "PURPOSE_FARM",
     "PURPOSE_USER",
 ]
 
@@ -158,6 +159,11 @@ class PurposeLane:
 #                are pool rows compiled from coordinates, so offered
 #                load is a pure function of the seed whatever
 #                trajectory the faults push the protocol onto.
+#   farm       — fuzzing-farm energy/scheduler draws (madsim_tpu.farm):
+#                corpus-entry power schedules key per-child streams at
+#                x1 = base, tenant budget draws at x1 = base + 1 —
+#                disjoint from the explore lane, so turning energy on
+#                or off never shifts a mutation draw.
 PURPOSE_LANES = (
     PurposeLane("poll_cost", 0, 1, "engine", "cost lane 0 / jitter lane 1"),
     PurposeLane("clog_jitter", 1, 1, "engine", "reserved/legacy"),
@@ -168,6 +174,7 @@ PURPOSE_LANES = (
     PurposeLane("plan", 0x9E370000, 1 << 16, "chaos", "base+plan slot"),
     PurposeLane("explore", 0x9E380000, 1 << 16, "explore", "base+batch slot"),
     PurposeLane("client", 0x9E390000, 1 << 16, "chaos", "base+plan slot"),
+    PurposeLane("farm", 0x9E3A0000, 1 << 16, "farm", "base+slot, energy"),
 )
 
 
@@ -248,6 +255,7 @@ PURPOSE_USER = lane("user").base  # + user purpose
 PURPOSE_PLAN = lane("plan").base  # + plan slot (host-side)
 PURPOSE_EXPLORE = lane("explore").base  # + batch slot (host-side)
 PURPOSE_CLIENT = lane("client").base  # + plan slot (host-side)
+PURPOSE_FARM = lane("farm").base  # + slot (host-side energy/scheduler)
 
 
 def _rotl32(x, r: int):

@@ -66,6 +66,7 @@ runs ``make_run_while``).
 
 from __future__ import annotations
 
+import os as _os
 import time as _time
 
 import numpy as np
@@ -91,7 +92,7 @@ from .mutate import (
     mutation_table,
 )
 
-__all__ = ["run_device"]
+__all__ = ["gen_cache_stats", "run_device"]
 
 
 def _kth_true(mask, k):
@@ -406,11 +407,41 @@ def _store_entry(st_np, i, name) -> CorpusEntry:
 # campaign session over fresh root seeds reuses one compiled program
 # per key (profiler-certified: retraces == 1). Entries hold
 # obs.prof.AotProgram pairs, so every build is phase-timed and
-# retrace-counted. Bounded FIFO (compiled executables are not free);
-# hold ONE workload/invariant object across campaigns to hit the cache,
-# exactly like engine.search.
+# retrace-counted. Bounded LRU (compiled executables are not free, and
+# a farm time-slicing N tenants in round-robin order would thrash a
+# FIFO into evicting exactly the program it is about to need again);
+# MADSIM_GEN_CACHE_MAX overrides the bound, evictions are counted
+# loudly (gen_cache_stats -> flight_summary). Hold ONE
+# workload/invariant object across campaigns to hit the cache, exactly
+# like engine.search.
 _GEN_CACHE: dict = {}
 _GEN_CACHE_MAX = 8
+_GEN_CACHE_EVICTIONS = 0
+
+
+def _gen_cache_max() -> int:
+    raw = _os.environ.get("MADSIM_GEN_CACHE_MAX")
+    if raw is None:
+        return _GEN_CACHE_MAX
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        raise ValueError(
+            f"MADSIM_GEN_CACHE_MAX={raw!r} is not an integer"
+        ) from None
+
+
+def gen_cache_stats() -> dict:
+    """Generation-program cache accounting: live entries, the effective
+    bound (``MADSIM_GEN_CACHE_MAX``) and lifetime evictions. The flight
+    recorder folds this into ``flight_summary`` — a growing eviction
+    count in a farm session means more tenant shapes than cache slots,
+    each switch re-paying trace+lower+compile; raise the knob."""
+    return {
+        "entries": len(_GEN_CACHE),
+        "max": _gen_cache_max(),
+        "evictions": _GEN_CACHE_EVICTIONS,
+    }
 
 
 def _mesh_key(mesh):
@@ -426,11 +457,18 @@ def _mesh_key(mesh):
 
 
 def _gen_programs(key, builder):
+    global _GEN_CACHE_EVICTIONS
     progs = _GEN_CACHE.get(key)
     if progs is None:
-        while len(_GEN_CACHE) >= _GEN_CACHE_MAX:
+        cap = _gen_cache_max()
+        while len(_GEN_CACHE) >= cap:
             _GEN_CACHE.pop(next(iter(_GEN_CACHE)))
+            _GEN_CACHE_EVICTIONS += 1
         progs = _GEN_CACHE[key] = builder()
+    else:
+        # LRU touch: re-insertion moves the entry to the back of the
+        # eviction order (dicts iterate in insertion order)
+        _GEN_CACHE[key] = _GEN_CACHE.pop(key)
     return progs[0], progs[1]
 
 
@@ -731,6 +769,361 @@ def _build_programs(
 # ---------------------------------------------------------------------------
 
 
+class _CampaignSession:
+    """Everything a device campaign shares between schedules.
+
+    ``run_device`` below and the pipelined driver
+    (``madsim_tpu.farm.pipeline.run_pipelined``) are the SAME campaign
+    — argument validation, checkpoint resume, the device carry, the
+    cached generation programs, host mirrors, telemetry and report
+    assembly all live here; the two drivers differ only in *when* they
+    block on a generation's admission summary. Keeping the semantics in
+    one place is what makes the pipelined schedule a scheduling change
+    rather than a semantic fork (the bit-identity tests lean on it).
+    """
+
+    def __init__(
+        self, wl, cfg, space, *, invariant, generations, batch, root_seed,
+        max_steps, cov_words, layout, require_halt, seed_corpus, select_top,
+        max_corpus, max_ops, inherit_seed_p, log, cov_hitcount, telemetry,
+        resume, checkpoint_path, latency, metrics, mesh, viol_cap,
+        pool_index, history_check,
+    ):
+        if isinstance(space, FaultPlan):
+            space = PlanSpace(space)
+        if history_check is not None:
+            from ..check.device import as_screens
+
+            history_check = as_screens(history_check)
+            if wl.history is None:
+                raise ValueError(
+                    f"history_check judges operation histories, but workload "
+                    f"{wl.name!r} has Workload.history=None"
+                )
+        if invariant is None and history_check is None:
+            raise ValueError(
+                "run_device needs a traceable final-state invariant and/or a "
+                "history_check screen set (both run inside the device "
+                "program); arbitrary host-side history_invariant callables "
+                "need the host driver — use explore.run for those hunts"
+            )
+        if cov_words < 1:
+            raise ValueError(
+                "exploration needs cov_words >= 1 (the guidance)"
+            )
+        if generations < 1 or batch < 1:
+            raise ValueError("need generations >= 1 and batch >= 1")
+        if len(seed_corpus) > batch:
+            raise ValueError(
+                f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
+            )
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        if batch % n_dev:
+            raise ValueError(
+                f"batch={batch} does not split over {n_dev} mesh devices"
+            )
+        vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
+        p_slots = space.slots
+        cmax1 = int(max_corpus) + 1
+        vcap1 = vcap + 1
+
+        # host-side validations the host driver gets from search_seeds:
+        # plan targets/user kinds against the workload, and the time32
+        # horizon (checked statically over the template windows —
+        # mutation and compilation both stay inside them)
+        space.plan.compile_batch(np.zeros(1, np.uint64), wl=wl)
+        if _resolve_time32(wl, cfg, None):
+            from ..engine.core import _T32_LIMIT
+
+            tb_np = mutation_table(space)
+            lim = _T32_LIMIT - cfg.proc_max_ns - 1
+            worst = int(tb_np["t_hi"].max(initial=1)) - 1
+            if seed_corpus:
+                worst = max(
+                    worst,
+                    max(e.t for lp in seed_corpus for e in lp.events),
+                )
+            if worst > lim:
+                raise ValueError(
+                    f"plan-space window reaches t={worst} ns, past the int32 "
+                    f"time horizon ({lim} ns) active for this (workload, "
+                    f"config); shrink the windows or disable time32"
+                )
+
+        # ---- resumed / fresh host mirrors ----
+        loaded_corpus: list = []
+        loaded_viol: list = []
+        if resume is not None:
+            from .persist import resolve_resume
+
+            st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
+                                cov_words, cov_hitcount)
+            if len(st.corpus) > max_corpus:
+                raise ValueError(
+                    f"checkpoint carries {len(st.corpus)} corpus entries; "
+                    f"max_corpus={max_corpus} cannot hold them"
+                )
+            if len(st.violations) > vcap:
+                raise ValueError(
+                    f"checkpoint carries {len(st.violations)} violations; "
+                    f"raise viol_cap (now {vcap})"
+                )
+            loaded_corpus = list(st.corpus)
+            loaded_viol = list(st.violations)
+            gmap0 = np.asarray(st.cov_map, np.uint32)
+            self.curve = list(st.curve)
+            self.viol_curve = list(st.viol_curve)
+            next_id0 = st.next_id
+            self.sims = st.sims
+            self.g_start = st.generations_done
+        else:
+            gmap0 = np.zeros((cov_words,), np.uint32)
+            self.curve = []
+            self.viol_curve = []
+            next_id0 = 0
+            self.sims = 0
+            self.g_start = 0
+
+        carry = dict(
+            c=_fill_store(
+                _empty_store(cmax1, p_slots, cov_words), loaded_corpus
+            ),
+            v=_fill_store(
+                _empty_store(vcap1, p_slots, cov_words), loaded_viol
+            ),
+            gmap=jnp.asarray(gmap0),
+            count=jnp.int32(len(loaded_corpus)),
+            next_id=jnp.int32(next_id0),
+            vcount=jnp.int32(len(loaded_viol)),
+            over=jnp.bool_(False),
+        )
+        if mesh is not None:
+            # commit the carry replicated up front: the cached generation
+            # programs are AOT executables pinned to their input shardings
+            # (obs.prof.AotProgram), and their outputs are constrained
+            # replicated to match — input placement must agree from the
+            # first call
+            carry = jax.device_put(carry, NamedSharding(mesh, P_()))
+        self.carry = carry
+        self.count = len(loaded_corpus)  # host mirror (uniform vs breed)
+
+        # materialized-entry caches: slot -> CorpusEntry. Loaded entries
+        # are returned as the same objects (names and identity survive
+        # resume); new slots materialize once and are reused by every
+        # later checkpoint/report build.
+        self._c_cache = {i: e for i, e in enumerate(loaded_corpus)}
+        self._v_cache = {i: e for i, e in enumerate(loaded_viol)}
+
+        # ---- the device programs (built once per cache key) ----
+        k_ov = len(seed_corpus)
+        key = (
+            id(wl), id(invariant), cfg.hash(), space.hash(), batch,
+            max_steps, cov_words, layout, require_halt, select_top,
+            int(max_corpus), vcap, max_ops, float(inherit_seed_p),
+            bool(cov_hitcount), bool(metrics), latency, _mesh_key(mesh),
+            tuple(lp.hash() for lp in seed_corpus), pool_index,
+            # invariant identity of the device history screen: screens
+            # are value-hashable literals, so equal screen sets share
+            # programs across campaigns (the ROADMAP "invariant
+            # identity" key component)
+            history_check,
+        )
+        self.prog_uniform, self.prog_breed = _gen_programs(
+            key,
+            lambda: _build_programs(
+                wl, cfg, space, invariant=invariant, batch=batch,
+                max_steps=max_steps, cov_words=cov_words, layout=layout,
+                require_halt=require_halt, select_top=select_top,
+                max_corpus=int(max_corpus), vcap=vcap, max_ops=max_ops,
+                inherit_seed_p=inherit_seed_p, cov_hitcount=cov_hitcount,
+                metrics=metrics, latency=latency, mesh=mesh,
+                seed_corpus=seed_corpus, cache_key=key,
+                pool_index=pool_index, history_check=history_check,
+            ),
+        )
+
+        self.wl = wl
+        self.cfg = cfg
+        self.space = space
+        self.generations = generations
+        self.batch = batch
+        self.root_seed = int(root_seed)
+        self.max_steps = max_steps
+        self.cov_words = cov_words
+        self.cov_hitcount = cov_hitcount
+        self.log = log
+        self.telemetry = telemetry
+        self.checkpoint_path = checkpoint_path
+        self.mesh = mesh
+        self.n_dev = n_dev
+        self.vcap = vcap
+        self.seed_corpus = seed_corpus
+        self.k_ov = k_ov
+        self.next_id = next_id0  # host mirror for snapshots
+        self.vcount_host = len(loaded_viol)
+        self.log_label = "device"
+        # the campaign root key enters the cached programs as a RUNTIME
+        # argument (same threefry coordinates as driver._derive_keys),
+        # so one compiled program serves every root seed
+        self.rk0 = jnp.uint32(self.root_seed & 0xFFFFFFFF)
+        self.rk1 = jnp.uint32((self.root_seed >> 32) & 0xFFFFFFFF)
+
+    # ---- scheduling primitives -----------------------------------------
+    def runner(self, breed: bool):
+        return self.prog_breed if breed else self.prog_uniform
+
+    def fleet(self, extras) -> dict:
+        """Fold a generation's sharded tap columns into fleet totals."""
+        fleet: dict = {}
+        if extras:
+            from .. import parallel as _par
+
+            if "met" in extras:
+                fleet["met_total"] = [
+                    int(x)
+                    for x in _par.merge_metrics(extras["met"], self.mesh)
+                ]
+            if "lat_hist" in extras:
+                fleet["lat_total_ops"] = int(
+                    _par.merge_latency(extras["lat_hist"], self.mesh).sum()
+                )
+        return fleet
+
+    def consume(self, g: int, s, fleet: dict, walls: dict,
+                carry=None) -> None:
+        """Fold generation ``g``'s admission summary into the host
+        mirrors: curve/corpus-count/violation bookkeeping, the
+        generation telemetry record (``walls`` carries the driver's
+        wall split), the log line, and the per-generation checkpoint.
+        ``carry`` is the carry AS OF after ``g`` — the pipelined driver
+        passes it explicitly because its ``self.carry`` has already
+        speculated ahead."""
+        if bool(s["over"]):
+            raise RuntimeError(
+                f"device violation store overflowed (viol_cap={self.vcap}) "
+                f"at generation {g}: the (seed, trace) dedup can no longer "
+                f"match the host driver — raise viol_cap"
+            )
+        self.sims += self.batch
+        self.count = int(s["count"])
+        self.next_id = int(s["next_id"])
+        new_viol = int(s["vcount"]) - self.vcount_host
+        self.vcount_host = int(s["vcount"])
+        self.curve.append(int(s["cov_bits"]))
+        self.viol_curve.append(self.vcount_host)
+        if self.log is not None:
+            self.log(
+                f"explore[{self.log_label}] g{g}: {self.curve[-1]} "
+                f"coverage bits (+{int(s['admitted'])} corpus entries, "
+                f"corpus {self.count}), {self.vcount_host} violations"
+            )
+        self.emit({
+            "event": "generation", "generation": g, "sims": self.sims,
+            "cov_bits": self.curve[-1], "new_entries": int(s["admitted"]),
+            "corpus_size": self.count, "violations": self.vcount_host,
+            "new_violations": new_viol, **walls, "host_syncs": 1, **fleet,
+        })
+        if self.checkpoint_path is not None:
+            self.snapshot(g + 1, carry=carry).save(self.checkpoint_path)
+
+    # ---- materialization ------------------------------------------------
+    def _entry_name(self, gen, parent, bslot, seed):
+        if parent >= 0:
+            return f"g{gen}p{parent}"
+        if gen == 0 and 0 <= bslot < self.k_ov:
+            return self.seed_corpus[bslot].name
+        return f"{self.space.plan.name}@{seed}"
+
+    def _materialize(self, carry_host):
+        cn = {k: np.asarray(v) for k, v in carry_host["c"].items()}
+        vn = {k: np.asarray(v) for k, v in carry_host["v"].items()}
+        n_c = int(carry_host["count"])
+        n_v = int(carry_host["vcount"])
+        c_cache, v_cache = self._c_cache, self._v_cache
+        for i in range(len(c_cache), n_c):
+            c_cache[i] = _store_entry(
+                cn, i,
+                self._entry_name(int(cn["gen"][i]), int(cn["parent"][i]),
+                                 int(cn["bslot"][i]), int(cn["seed"][i])),
+            )
+        corpus = [c_cache[i] for i in range(n_c)]
+        by_id = {e.id: e for e in corpus}
+        for i in range(len(v_cache), min(n_v, self.vcap)):
+            eid = int(vn["id"][i])
+            # a violating entry that also joined the corpus is the SAME
+            # object in both lists (the host driver's sharing)
+            v_cache[i] = by_id.get(eid) or _store_entry(
+                vn, i,
+                self._entry_name(int(vn["gen"][i]), int(vn["parent"][i]),
+                                 int(vn["bslot"][i]), int(vn["seed"][i])),
+            )
+        violations = [v_cache[i] for i in range(min(n_v, self.vcap))]
+        return corpus, violations, np.asarray(carry_host["gmap"], np.uint32)
+
+    def snapshot(self, gens_done: int, carry=None):
+        from .persist import CampaignState
+
+        corpus, violations, gm = self._materialize(
+            jax.device_get(self.carry if carry is None else carry)
+        )
+        return CampaignState(
+            workload=self.wl.name, config_hash=self.cfg.hash(),
+            plan_hash=self.space.hash(), root_seed=self.root_seed,
+            batch=self.batch, cov_words=self.cov_words,
+            cov_hitcount=self.cov_hitcount, generations_done=gens_done,
+            next_id=self.next_id, sims=self.sims, curve=list(self.curve),
+            viol_curve=list(self.viol_curve), cov_map=gm.copy(),
+            corpus=list(corpus), violations=list(violations),
+        )
+
+    # ---- telemetry + report ---------------------------------------------
+    def emit(self, record: dict) -> None:
+        if self.telemetry is not None:
+            self.telemetry(record)
+
+    def start(self, driver: str, **extra) -> None:
+        self.emit({
+            "event": "campaign_start", "workload": self.wl.name,
+            "config_hash": self.cfg.hash(), "plan_hash": self.space.hash(),
+            "root_seed": self.root_seed, "batch": self.batch,
+            "generations": self.generations, "cov_words": self.cov_words,
+            "cov_hitcount": self.cov_hitcount,
+            "resumed_at_generation": self.g_start,
+            "driver": driver, "mesh_devices": self.n_dev, **extra,
+        })
+
+    def report(self, *, wall_dispatch, wall_sync, wall_compile, host_syncs,
+               wall_queue=0.0, wall_idle=0.0) -> ExploreReport:
+        corpus, violations, gm = self._materialize(
+            jax.device_get(self.carry)
+        )
+        return ExploreReport(
+            workload=self.wl.name,
+            config_hash=self.cfg.hash(),
+            plan_hash=self.space.hash(),
+            root_seed=self.root_seed,
+            generations=self.g_start + self.generations,
+            batch=self.batch,
+            max_steps=self.max_steps,
+            cov_words=self.cov_words,
+            sims=self.sims,
+            corpus=corpus,
+            violations=violations,
+            cov_map=gm,
+            curve=self.curve,
+            viol_curve=self.viol_curve,
+            next_id=self.next_id,
+            cov_hitcount=self.cov_hitcount,
+            wall_dispatch_s=wall_dispatch,
+            wall_host_s=wall_sync,
+            wall_compile_s=wall_compile,
+            host_syncs=host_syncs,
+            wall_gens=self.generations,
+            wall_queue_s=wall_queue,
+            wall_idle_s=wall_idle,
+        )
+
+
 def run_device(
     wl,
     cfg,
@@ -803,229 +1196,32 @@ def run_device(
     campaigns (the ``engine.search`` rule) and every later campaign
     runs compile-free.
     """
-    if isinstance(space, FaultPlan):
-        space = PlanSpace(space)
-    if history_check is not None:
-        from ..check.device import as_screens
-
-        history_check = as_screens(history_check)
-        if wl.history is None:
-            raise ValueError(
-                f"history_check judges operation histories, but workload "
-                f"{wl.name!r} has Workload.history=None"
-            )
-    if invariant is None and history_check is None:
-        raise ValueError(
-            "run_device needs a traceable final-state invariant and/or a "
-            "history_check screen set (both run inside the device "
-            "program); arbitrary host-side history_invariant callables "
-            "need the host driver — use explore.run for those hunts"
-        )
-    if cov_words < 1:
-        raise ValueError("exploration needs cov_words >= 1 (the guidance)")
-    if generations < 1 or batch < 1:
-        raise ValueError("need generations >= 1 and batch >= 1")
-    if len(seed_corpus) > batch:
-        raise ValueError(
-            f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
-        )
-    n_dev = int(mesh.devices.size) if mesh is not None else 1
-    if batch % n_dev:
-        raise ValueError(
-            f"batch={batch} does not split over {n_dev} mesh devices"
-        )
-    vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
-    p_slots = space.slots
-    cmax1 = int(max_corpus) + 1
-    vcap1 = vcap + 1
-
-    # host-side validations the host driver gets from search_seeds:
-    # plan targets/user kinds against the workload, and the time32
-    # horizon (checked statically over the template windows — mutation
-    # and compilation both stay inside them)
-    space.plan.compile_batch(np.zeros(1, np.uint64), wl=wl)
-    if _resolve_time32(wl, cfg, None):
-        from ..engine.core import _T32_LIMIT
-
-        tb_np = mutation_table(space)
-        lim = _T32_LIMIT - cfg.proc_max_ns - 1
-        worst = int(tb_np["t_hi"].max(initial=1)) - 1
-        if seed_corpus:
-            worst = max(
-                worst,
-                max(e.t for lp in seed_corpus for e in lp.events),
-            )
-        if worst > lim:
-            raise ValueError(
-                f"plan-space window reaches t={worst} ns, past the int32 "
-                f"time horizon ({lim} ns) active for this (workload, "
-                f"config); shrink the windows or disable time32"
-            )
-
-    # ---- resumed / fresh host mirrors ----
-    loaded_corpus: list = []
-    loaded_viol: list = []
-    if resume is not None:
-        from .persist import resolve_resume
-
-        st = resolve_resume(resume, wl, space, cfg, root_seed, batch,
-                            cov_words, cov_hitcount)
-        if len(st.corpus) > max_corpus:
-            raise ValueError(
-                f"checkpoint carries {len(st.corpus)} corpus entries; "
-                f"max_corpus={max_corpus} cannot hold them"
-            )
-        if len(st.violations) > vcap:
-            raise ValueError(
-                f"checkpoint carries {len(st.violations)} violations; "
-                f"raise viol_cap (now {vcap})"
-            )
-        loaded_corpus = list(st.corpus)
-        loaded_viol = list(st.violations)
-        gmap0 = np.asarray(st.cov_map, np.uint32)
-        curve = list(st.curve)
-        viol_curve = list(st.viol_curve)
-        next_id0 = st.next_id
-        sims = st.sims
-        g_start = st.generations_done
-    else:
-        gmap0 = np.zeros((cov_words,), np.uint32)
-        curve = []
-        viol_curve = []
-        next_id0 = 0
-        sims = 0
-        g_start = 0
-
-    carry = dict(
-        c=_fill_store(_empty_store(cmax1, p_slots, cov_words), loaded_corpus),
-        v=_fill_store(_empty_store(vcap1, p_slots, cov_words), loaded_viol),
-        gmap=jnp.asarray(gmap0),
-        count=jnp.int32(len(loaded_corpus)),
-        next_id=jnp.int32(next_id0),
-        vcount=jnp.int32(len(loaded_viol)),
-        over=jnp.bool_(False),
+    sess = _CampaignSession(
+        wl, cfg, space, invariant=invariant, generations=generations,
+        batch=batch, root_seed=root_seed, max_steps=max_steps,
+        cov_words=cov_words, layout=layout, require_halt=require_halt,
+        seed_corpus=seed_corpus, select_top=select_top,
+        max_corpus=max_corpus, max_ops=max_ops,
+        inherit_seed_p=inherit_seed_p, log=log, cov_hitcount=cov_hitcount,
+        telemetry=telemetry, resume=resume,
+        checkpoint_path=checkpoint_path, latency=latency, metrics=metrics,
+        mesh=mesh, viol_cap=viol_cap, pool_index=pool_index,
+        history_check=history_check,
     )
-    if mesh is not None:
-        # commit the carry replicated up front: the cached generation
-        # programs are AOT executables pinned to their input shardings
-        # (obs.prof.AotProgram), and their outputs are constrained
-        # replicated to match — input placement must agree from the
-        # first call
-        carry = jax.device_put(carry, NamedSharding(mesh, P_()))
-    count = len(loaded_corpus)  # host mirror (decides uniform vs breed)
-
-    # materialized-entry caches: slot -> CorpusEntry. Loaded entries are
-    # returned as the same objects (names and identity survive resume);
-    # new slots materialize once and are reused by every later
-    # checkpoint/report build.
-    c_cache = {i: e for i, e in enumerate(loaded_corpus)}
-    v_cache = {i: e for i, e in enumerate(loaded_viol)}
-
-    # ---- the device programs (built once per cache key) ----
-    k_ov = len(seed_corpus)
-    key = (
-        id(wl), id(invariant), cfg.hash(), space.hash(), batch, max_steps,
-        cov_words, layout, require_halt, select_top, int(max_corpus), vcap,
-        max_ops, float(inherit_seed_p), bool(cov_hitcount), bool(metrics),
-        latency, _mesh_key(mesh), tuple(lp.hash() for lp in seed_corpus),
-        pool_index,
-        # invariant identity of the device history screen: screens are
-        # value-hashable literals, so equal screen sets share programs
-        # across campaigns (the ROADMAP "invariant identity" key
-        # component)
-        history_check,
-    )
-    prog_uniform, prog_breed = _gen_programs(
-        key,
-        lambda: _build_programs(
-            wl, cfg, space, invariant=invariant, batch=batch,
-            max_steps=max_steps, cov_words=cov_words, layout=layout,
-            require_halt=require_halt, select_top=select_top,
-            max_corpus=int(max_corpus), vcap=vcap, max_ops=max_ops,
-            inherit_seed_p=inherit_seed_p, cov_hitcount=cov_hitcount,
-            metrics=metrics, latency=latency, mesh=mesh,
-            seed_corpus=seed_corpus, cache_key=key, pool_index=pool_index,
-            history_check=history_check,
-        ),
-    )
-
-    # ---- materialization ----
-    def _entry_name(gen, parent, bslot, seed):
-        if parent >= 0:
-            return f"g{gen}p{parent}"
-        if gen == 0 and 0 <= bslot < k_ov:
-            return seed_corpus[bslot].name
-        return f"{space.plan.name}@{seed}"
-
-    def _materialize(carry_host):
-        cn = {k: np.asarray(v) for k, v in carry_host["c"].items()}
-        vn = {k: np.asarray(v) for k, v in carry_host["v"].items()}
-        n_c = int(carry_host["count"])
-        n_v = int(carry_host["vcount"])
-        for i in range(len(c_cache), n_c):
-            c_cache[i] = _store_entry(
-                cn, i,
-                _entry_name(int(cn["gen"][i]), int(cn["parent"][i]),
-                            int(cn["bslot"][i]), int(cn["seed"][i])),
-            )
-        corpus = [c_cache[i] for i in range(n_c)]
-        by_id = {e.id: e for e in corpus}
-        for i in range(len(v_cache), min(n_v, vcap)):
-            eid = int(vn["id"][i])
-            # a violating entry that also joined the corpus is the SAME
-            # object in both lists (the host driver's sharing)
-            v_cache[i] = by_id.get(eid) or _store_entry(
-                vn, i,
-                _entry_name(int(vn["gen"][i]), int(vn["parent"][i]),
-                            int(vn["bslot"][i]), int(vn["seed"][i])),
-            )
-        violations = [v_cache[i] for i in range(min(n_v, vcap))]
-        return corpus, violations, np.asarray(carry_host["gmap"], np.uint32)
-
-    def _snapshot(gens_done):
-        from .persist import CampaignState
-
-        corpus, violations, gm = _materialize(jax.device_get(carry))
-        return CampaignState(
-            workload=wl.name, config_hash=cfg.hash(),
-            plan_hash=space.hash(), root_seed=int(root_seed), batch=batch,
-            cov_words=cov_words, cov_hitcount=cov_hitcount,
-            generations_done=gens_done, next_id=int(carry_np_next_id[0]),
-            sims=sims, curve=list(curve), viol_curve=list(viol_curve),
-            cov_map=gm.copy(), corpus=list(corpus),
-            violations=list(violations),
-        )
-
-    def _emit(record):
-        if telemetry is not None:
-            telemetry(record)
-
-    _emit({
-        "event": "campaign_start", "workload": wl.name,
-        "config_hash": cfg.hash(), "plan_hash": space.hash(),
-        "root_seed": int(root_seed), "batch": batch,
-        "generations": generations, "cov_words": cov_words,
-        "cov_hitcount": cov_hitcount, "resumed_at_generation": g_start,
-        "driver": "device", "mesh_devices": n_dev,
-    })
+    sess.start("device")
 
     wall_dispatch = 0.0
     wall_sync = 0.0
     wall_compile = 0.0
     host_syncs = 0
-    carry_np_next_id = [next_id0]  # host mirror for snapshots
-    vcount_host = len(loaded_viol)
-    # the campaign root key enters the cached programs as a RUNTIME
-    # argument (same threefry coordinates as driver._derive_keys), so
-    # one compiled program serves every root seed
-    rk0 = jnp.uint32(int(root_seed) & 0xFFFFFFFF)
-    rk1 = jnp.uint32((int(root_seed) >> 32) & 0xFFFFFFFF)
 
-    for g in range(g_start, g_start + generations):
+    for g in range(sess.g_start, sess.g_start + generations):
         t0 = _time.monotonic()  # lint: allow(wall-clock)
-        breed = g > 0 and count > 0
-        runner = prog_breed if breed else prog_uniform
-        carry, summary, extras = runner(carry, jnp.uint32(g), rk0, rk1)
+        breed = g > 0 and sess.count > 0
+        runner = sess.runner(breed)
+        sess.carry, summary, extras = runner(
+            sess.carry, jnp.uint32(g), sess.rk0, sess.rk1
+        )
         jax.block_until_ready(summary)
         t1 = _time.monotonic()  # lint: allow(wall-clock)
         # trace/lower/compile share of this generation (0.0 on a warm
@@ -1036,85 +1232,35 @@ def run_device(
         # per-seed state stays on device
         s = jax.device_get(summary)
         host_syncs += 1
-        fleet = {}
-        if extras:
-            from .. import parallel as _par
-
-            if "met" in extras:
-                fleet["met_total"] = [
-                    int(x) for x in _par.merge_metrics(extras["met"], mesh)
-                ]
-            if "lat_hist" in extras:
-                fleet["lat_total_ops"] = int(
-                    _par.merge_latency(extras["lat_hist"], mesh).sum()
-                )
+        fleet = sess.fleet(extras)
         t2 = _time.monotonic()  # lint: allow(wall-clock)
-        if bool(s["over"]):
-            raise RuntimeError(
-                f"device violation store overflowed (viol_cap={vcap}) at "
-                f"generation {g}: the (seed, trace) dedup can no longer "
-                f"match the host driver — raise viol_cap"
-            )
-        sims += batch
-        count = int(s["count"])
-        carry_np_next_id[0] = int(s["next_id"])
-        new_viol = int(s["vcount"]) - vcount_host
-        vcount_host = int(s["vcount"])
-        curve.append(int(s["cov_bits"]))
-        viol_curve.append(vcount_host)
         wall_dispatch += (t1 - t0) - compile_wall
         wall_sync += t2 - t1
         wall_compile += compile_wall
-        if log is not None:
-            log(
-                f"explore[device] g{g}: {curve[-1]} coverage bits "
-                f"(+{int(s['admitted'])} corpus entries, corpus {count}), "
-                f"{vcount_host} violations"
-            )
-        _emit({
-            "event": "generation", "generation": g, "sims": sims,
-            "cov_bits": curve[-1], "new_entries": int(s["admitted"]),
-            "corpus_size": count, "violations": vcount_host,
-            "new_violations": new_viol,
+        sess.consume(g, s, fleet, {
             "dispatch_wall_s": round((t1 - t0) - compile_wall, 3),
             "compile_wall_s": round(compile_wall, 3),
             "sync_wall_s": round(t2 - t1, 3),
-            "host_syncs": 1, **fleet,
+            # the pipeline wall split, zero by construction on the
+            # blocking schedule (the driver never enqueues ahead)
+            "queue_wall_s": 0.0,
+            "idle_wall_s": 0.0,
         })
-        if checkpoint_path is not None:
-            _snapshot(g + 1).save(checkpoint_path)
 
-    _emit({
-        "event": "campaign_end", "generations": g_start + generations,
+    sess.emit({
+        "event": "campaign_end", "generations": sess.g_start + generations,
         "generations_run": generations,
-        "sims": sims, "cov_bits": curve[-1] if curve else 0,
-        "corpus_size": count, "violations": vcount_host,
+        "sims": sess.sims,
+        "cov_bits": sess.curve[-1] if sess.curve else 0,
+        "corpus_size": sess.count, "violations": sess.vcount_host,
         "wall_dispatch_s": round(wall_dispatch, 3),
         "wall_sync_s": round(wall_sync, 3),
         "wall_compile_s": round(wall_compile, 3),
+        "wall_queue_s": 0.0,
+        "wall_idle_s": 0.0,
         "host_syncs": host_syncs,
     })
-    corpus, violations, gm = _materialize(jax.device_get(carry))
-    return ExploreReport(
-        workload=wl.name,
-        config_hash=cfg.hash(),
-        plan_hash=space.hash(),
-        root_seed=int(root_seed),
-        generations=g_start + generations,
-        batch=batch,
-        max_steps=max_steps,
-        cov_words=cov_words,
-        sims=sims,
-        corpus=corpus,
-        violations=violations,
-        cov_map=gm,
-        curve=curve,
-        viol_curve=viol_curve,
-        next_id=carry_np_next_id[0],
-        cov_hitcount=cov_hitcount,
-        wall_dispatch_s=wall_dispatch,
-        wall_host_s=wall_sync,
-        wall_compile_s=wall_compile,
-        host_syncs=host_syncs,
-        wall_gens=generations,
+    return sess.report(
+        wall_dispatch=wall_dispatch, wall_sync=wall_sync,
+        wall_compile=wall_compile, host_syncs=host_syncs,
     )

@@ -120,6 +120,15 @@ class ExploreReport:
     # ``generations`` counts from generation 0 — the banner pairs
     # syncs against this, not the absolute total
     wall_gens: int = 0
+    # pipelined-schedule wall split (madsim_tpu.farm.pipeline): queue =
+    # host time spent ENQUEUEING dispatches ahead of the consume point,
+    # idle = host time blocked waiting for a generation the device had
+    # not finished. Both 0.0 on the blocking drivers — a nonzero split
+    # is the measured proof that host-side work (checkpointing,
+    # telemetry) overlapped device compute instead of serializing after
+    # it. On the pipelined driver wall_dispatch_s == queue + idle.
+    wall_queue_s: float = 0.0
+    wall_idle_s: float = 0.0
 
     @property
     def coverage_bits(self) -> int:
@@ -157,6 +166,12 @@ class ExploreReport:
                     f"+ {self.wall_host_s:.2f}s host-driven loop"
                     f"{compile_note} ({frac:.1%} host)"
                 )
+        if self.wall_queue_s or self.wall_idle_s:
+            lines.append(
+                f"  pipeline: {self.wall_queue_s:.2f}s enqueue + "
+                f"{self.wall_idle_s:.2f}s idle at consume (host work "
+                f"overlapped device compute)"
+            )
         for e in self.violations[:limit]:
             lines.append(
                 f"  violation g{e.generation} id{e.id}: seed {e.seed} "
@@ -302,6 +317,7 @@ def run(
     checkpoint_path: str | None = None,
     latency=None,
     pool_index: bool | None = None,
+    energy=None,
 ) -> ExploreReport:
     """Run one coverage-guided exploration campaign.
 
@@ -342,6 +358,16 @@ def run(
     latency-bucket coverage bits steer the campaign toward schedules
     that move the tail, and p99 breaches are violations like any other
     (dedup, shrink, replay all apply).
+
+    ``energy`` (a ``madsim_tpu.farm.EnergySchedule``) replaces the
+    uniform parent pick with an AFLFast-style power schedule: per-entry
+    energy decays with times-picked and boosts rare-path coverage and
+    violations, and seed inheritance becomes per-parent. Energy draws
+    come from the dedicated ``farm`` threefry lane, so the explore-lane
+    mutation stream is untouched draw-for-draw — ``energy=None`` (or a
+    uniform-mode schedule) is bit-identical to the historical behavior
+    (test-pinned), which keeps ``select_top``/``inherit_seed_p`` as the
+    reproducible defaults.
     """
     import time as _time
 
@@ -356,6 +382,9 @@ def run(
             f"{len(seed_corpus)} seed-corpus plans exceed batch={batch}"
         )
     dup = space.uses_dup()
+    # per-campaign mutable energy state (times-picked counters); None
+    # means the uniform schedule — the historical, bit-pinned path
+    est = energy.state() if energy is not None and energy.active else None
     if resume is not None:
         from .persist import resolve_resume
 
@@ -453,16 +482,30 @@ def run(
             plans = []
             parents = []
             seeds = seeds.copy()
+            if est is not None:
+                pool, cum = est.pool(corpus, select_top)
             for j in range(batch):
                 st = HostStream(int(k0s[j]), int(k1s[j]), PURPOSE_EXPLORE)
-                pid = order[st.bits() % len(order)]
+                # draw 0 of the explore stream is ALWAYS consumed: under
+                # an energy schedule the parent pick moves to the farm
+                # lane, but the mutation draws that follow (j >= 2) must
+                # stay at the same counters either way
+                w0 = st.bits()
+                if est is None:
+                    pid = order[w0 % len(order)]
+                    thresh = inherit_threshold(inherit_seed_p)
+                else:
+                    pid = est.choose(int(k0s[j]), int(k1s[j]), pool, cum)
+                    thresh = est.inherit_threshold(
+                        by_id[pid], inherit_seed_p
+                    )
                 parents.append(pid)
                 # inheriting children keep the parent's engine seed:
                 # protocol timing stays fixed while the plan mutates,
                 # so a near-miss fault alignment can be tuned instead
                 # of re-rolled (the rest re-key both, keeping
                 # seed-space exploration alive)
-                if st.bits() < inherit_threshold(inherit_seed_p):
+                if st.bits() < thresh:
                     seeds[j] = np.uint64(by_id[pid].seed)
                 parent = by_id[pid]
                 plans.append(
@@ -558,6 +601,10 @@ def run(
             "mutate_wall_s": round(mutate_wall, 3),
             "admit_wall_s": round(admit_wall, 3),
             "host_wall_s": round(host_wall, 3),
+            # pipeline wall split: structurally zero on the host-driven
+            # blocking loop (same schema as the pipelined driver)
+            "queue_wall_s": 0.0,
+            "idle_wall_s": 0.0,
         })
         if checkpoint_path is not None:
             _snapshot(g + 1).save(checkpoint_path)
@@ -570,6 +617,8 @@ def run(
         "wall_dispatch_s": round(wall_dispatch, 3),
         "wall_host_s": round(wall_host, 3),
         "wall_compile_s": round(wall_compile, 3),
+        "wall_queue_s": 0.0,
+        "wall_idle_s": 0.0,
     })
     return ExploreReport(
         workload=wl.name,

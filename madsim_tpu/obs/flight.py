@@ -39,6 +39,7 @@ the recorder — nothing flows back into the campaign.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 from . import prof as _prof
@@ -117,6 +118,22 @@ class FlightRecorder:
         elif ev == "campaign_end":
             self._write(self._summary(), now)
 
+    def tagged(self, tenant: str):
+        """A per-tenant view of this recorder for farm scheduling.
+
+        The returned callable stamps every record with ``"tenant"``
+        before feeding it to the shared recorder, so N scheduled
+        campaigns interleave into ONE flight log with one monotone
+        ``seq``/``t_s`` spine — heartbeats and the flight summary stay
+        farm-wide, and ``tools/campaign_top.py`` splits the stream back
+        into per-tenant tables by the tag. Existing ``"tenant"`` keys
+        are preserved (re-tagging a tagged stream is a no-op)."""
+        def _sink(record: dict, _t=str(tenant)) -> None:
+            if "tenant" not in record:
+                record = {**record, "tenant": _t}
+            self(record)
+        return _sink
+
     def _write(self, record: dict, now: float) -> None:
         rec = dict(record)
         rec["seq"] = self._seq
@@ -138,6 +155,8 @@ class FlightRecorder:
             "corpus_size": self._last_gen.get("corpus_size"),
             "violations": self._last_gen.get("violations"),
         }
+        if "tenant" in self._last_gen:
+            hb["tenant"] = self._last_gen["tenant"]
         if self._memory:
             hb.update(_prof.device_memory())
         return hb
@@ -149,6 +168,12 @@ class FlightRecorder:
             out["programs"] = p.to_dicts()
         if self._memory:
             out["memory"] = _prof.device_memory()
+        # generation-program cache accounting (LRU size + evictions) —
+        # checked via sys.modules so recording a host-only campaign
+        # never drags the device driver in
+        dev = sys.modules.get("madsim_tpu.explore.device")
+        if dev is not None:
+            out["gen_cache"] = dev.gen_cache_stats()
         return out
 
     # -- lifecycle --------------------------------------------------------

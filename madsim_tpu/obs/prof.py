@@ -348,3 +348,35 @@ class AotProgram:
             self.name, self.key, time.monotonic() - t0  # lint: allow(wall-clock)
         )
         return out
+
+    def call_async(self, *args):
+        """``__call__`` without the profiler's completion barrier.
+
+        The profiled ``__call__`` blocks on the outputs so
+        ``execute_wall_s`` measures device time — which would serialize
+        a pipelined schedule right back into the blocking one. This
+        path ENQUEUES only (jax async dispatch; the caller owns the
+        ``block_until_ready`` at its consume point): builds are still
+        timed and retrace-counted identically, calls are still counted,
+        but the profiler's per-call execute wall is recorded as the
+        enqueue cost (~0), with the real device wall visible in the
+        caller's queue/idle split instead.
+        """
+        self.last_build_s = 0.0
+        sig = _signature(args)
+        exe = self._exes.get(sig)
+        if exe is None:
+            exe = self._build(sig, args)
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        try:
+            out = exe(*args)
+        except (TypeError, ValueError):
+            exe = self._build(sig, args)
+            t0 = time.monotonic()  # lint: allow(wall-clock)
+            out = exe(*args)
+        if _ACTIVE is not None:
+            _ACTIVE.note_execute(
+                self.name, self.key,
+                time.monotonic() - t0,  # lint: allow(wall-clock)
+            )
+        return out

@@ -1,0 +1,455 @@
+"""The fuzzing farm (madsim_tpu/farm/) — pipelined generations,
+multi-tenant scheduling, adaptive energy.
+
+Pins, per the round's contract: the pipelined driver is bit-identical
+to blocking ``run_device`` (corpus, coverage, violations, checkpoints)
+while emitting the ``queue_wall_s``/``idle_wall_s`` split with
+``host_syncs`` still 1/generation; a farm-scheduled tenant equals its
+standalone run across preemption splices, with every generation
+program traced exactly once for the whole session
+(profiler-certified); the ``_GEN_CACHE`` LRU honors
+``MADSIM_GEN_CACHE_MAX``, counts evictions, and an evicted program
+re-traces without changing results; energy off/uniform is
+bit-identical to the historical schedule and adaptive energy is
+deterministic. Soak-scale certificates (the >= 1.25x gens/s A/B, the
+3-tenant session, the adaptive-vs-uniform planted-bug hunt) live in
+tools/farm_soak.py (FARM_r11.txt)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from madsim_tpu import explore, farm, obs
+from madsim_tpu.chaos import FaultPlan, GrayFailure, PauseStorm
+from madsim_tpu.engine import EngineConfig
+from madsim_tpu.explore import device as _device
+from madsim_tpu.farm import EnergySchedule, FarmEnergy, Tenant
+from madsim_tpu.models import make_raft
+from madsim_tpu.obs import prof
+
+NODES = (0, 1, 2, 3, 4)
+CFG = EngineConfig(pool_size=64, loss_p=0.02)
+PLAN = FaultPlan((
+    PauseStorm(targets=NODES, n=1, t_min_ns=20_000_000,
+               t_max_ns=300_000_000, down_min_ns=50_000_000,
+               down_max_ns=200_000_000),
+    GrayFailure(targets=NODES, n_links=1),
+), name="farm-test")
+
+
+def _halt_inv(view):
+    return view["halted"]
+
+
+def _biased_inv(view):
+    # deterministic pure-function-of-final-state "bug" (the
+    # test_explore_device recipe): low-trace-hash seeds violate
+    return (view["trace"] & 7) != 0
+
+
+# ONE workload + invariant identity across the module (program caches
+# key on identity); ONE campaign shape for most tests so the whole
+# file shares two compiled programs
+WL = make_raft()
+KW = dict(generations=3, batch=16, root_seed=11, max_steps=200,
+          cov_words=8, invariant=_halt_inv)
+
+
+def _fp(rep):
+    return (
+        [(e.id, e.generation, e.parent, e.seed, e.plan.hash(), e.trace,
+          e.new_bits, e.violating) for e in rep.corpus],
+        rep.cov_map.tolist(),
+        [(e.seed, e.trace) for e in rep.violations],
+        rep.curve,
+        rep.viol_curve,
+    )
+
+
+# lazily computed shared campaigns (tier-1 wall is a budgeted resource)
+_SHARED: dict = {}
+
+
+def _rep_blocking():
+    if "blocking" not in _SHARED:
+        _SHARED["blocking"] = explore.run_device(WL, CFG, PLAN, **KW)
+    return _SHARED["blocking"]
+
+
+def _rep_pipelined():
+    if "pipelined" not in _SHARED:
+        records = []
+        _SHARED["pipelined"] = farm.run_pipelined(
+            WL, CFG, PLAN, telemetry=records.append, **KW
+        )
+        _SHARED["pipelined-records"] = records
+    return _SHARED["pipelined"]
+
+
+# ---------------------------------------------------------------------------
+# pipelined generations
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_matches_blocking_bit_identical():
+    assert _fp(_rep_blocking()) == _fp(_rep_pipelined())
+
+
+def test_pipelined_wall_split_schema():
+    _rep_pipelined()
+    recs = _SHARED["pipelined-records"]
+    gens = [r for r in recs if r.get("event") == "generation"]
+    assert len(gens) == KW["generations"]
+    for g in gens:
+        # full device wall split, plus the pipeline's queue/idle view;
+        # the ONE consume-point sync per generation is the design
+        for k in ("dispatch_wall_s", "compile_wall_s", "sync_wall_s",
+                  "queue_wall_s", "idle_wall_s"):
+            assert k in g, f"missing {k}"
+        assert g["host_syncs"] == 1
+        assert g["dispatch_wall_s"] == pytest.approx(
+            g["queue_wall_s"] + g["idle_wall_s"], abs=2e-3
+        )
+    end = next(r for r in recs if r.get("event") == "campaign_end")
+    assert {"wall_queue_s", "wall_idle_s", "respeculations"} <= set(end)
+    assert end["host_syncs"] == KW["generations"]
+    # raft admits from generation 0, so breed speculation never misses
+    assert end["respeculations"] == 0
+    start = next(r for r in recs if r.get("event") == "campaign_start")
+    assert start["driver"] == "device-pipelined"
+    assert start["pipeline_depth"] == 2
+    rep = _SHARED["pipelined"]
+    assert rep.wall_dispatch_s == pytest.approx(
+        rep.wall_queue_s + rep.wall_idle_s, abs=1e-6
+    )
+    assert "pipeline:" in rep.banner()
+    # blocking reports render no pipeline line (zeros stay silent)
+    assert "pipeline:" not in _rep_blocking().banner()
+
+
+def test_pipelined_checkpoint_resume_splice(tmp_path):
+    # the per-generation checkpoint must snapshot the campaign AS OF
+    # that generation (not the speculative head): resume from a
+    # pipelined checkpoint and land exactly on the uninterrupted run
+    path = tmp_path / "pipe.ckpt"
+    farm.run_pipelined(
+        WL, CFG, PLAN, **{**KW, "generations": 2},
+        checkpoint_path=str(path),
+    )
+    resumed = farm.run_pipelined(
+        WL, CFG, PLAN, **{**KW, "generations": 1}, resume=str(path),
+    )
+    assert _fp(resumed) == _fp(_rep_blocking())
+
+
+def test_pipelined_depth_validation():
+    with pytest.raises(ValueError, match="depth"):
+        farm.run_pipelined(WL, CFG, PLAN, depth=0, **KW)
+
+
+# ---------------------------------------------------------------------------
+# the farm scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_farm_two_tenant_preemption_bit_identity():
+    # different campaign shapes (batch) -> distinct program cache keys:
+    # the retrace pin below certifies tenant SWITCHING is compile-free
+    kw2 = dict(invariant=_biased_inv, batch=24, root_seed=5,
+               max_steps=200, cov_words=8)
+    _device._GEN_CACHE.clear()
+    with prof.profiled() as p:
+        ref_a = explore.run_device(WL, CFG, PLAN, **KW)
+        ref_b = explore.run_device(WL, CFG, PLAN, generations=3, **kw2)
+        records = []
+        fr = farm.run_farm(
+            [
+                Tenant("halt", WL, CFG, PLAN, generations=3,
+                       kwargs={k: v for k, v in KW.items()
+                               if k != "generations"}),
+                Tenant("biased", WL, CFG, PLAN, generations=3,
+                       kwargs=kw2),
+            ],
+            quantum=1, telemetry=records.append,
+        )
+    # preemption = the checkpoint/resume splice: scheduled == standalone
+    assert _fp(fr.reports["halt"]) == _fp(ref_a)
+    assert _fp(fr.reports["biased"]) == _fp(ref_b)
+    # round-robin in declaration order, one-generation quanta
+    assert fr.schedule == [
+        (0, "halt", 1), (1, "biased", 1), (2, "halt", 1),
+        (3, "biased", 1), (4, "halt", 1), (5, "biased", 1),
+    ]
+    assert fr.preemptions == {"halt": 2, "biased": 2}
+    # every program traced EXACTLY once across standalone + 6 slices
+    retr = p.retraces("explore.device")
+    assert retr and all(v == 1 for v in retr.values())
+    # every slice record carries its tenant tag
+    gens = [r for r in records if r.get("event") == "generation"]
+    assert len(gens) == 6
+    assert {g["tenant"] for g in gens} == {"halt", "biased"}
+    assert "2 tenants over 6 slices" in fr.banner()
+
+
+def test_farm_total_generations_budget():
+    fr = farm.run_farm(
+        [Tenant("only", WL, CFG, PLAN, generations=None,
+                kwargs={k: v for k, v in KW.items()
+                        if k != "generations"})],
+        quantum=2, total_generations=3,
+    )
+    # the farm budget bounds an unbounded tenant, last slice truncated
+    assert [g for _, _, g in fr.schedule] == [2, 1]
+    assert fr.reports["only"].generations == 3
+    assert _fp(fr.reports["only"]) == _fp(_rep_blocking())
+
+
+def test_farm_validation():
+    t = Tenant("a", WL, CFG, PLAN, generations=1, kwargs={})
+    with pytest.raises(ValueError, match="at least one"):
+        farm.run_farm([])
+    with pytest.raises(ValueError, match="unique"):
+        farm.run_farm([t, Tenant("a", WL, CFG, PLAN, generations=1)])
+    with pytest.raises(ValueError, match="quantum"):
+        farm.run_farm([t], quantum=0)
+    with pytest.raises(ValueError, match="budget"):
+        farm.run_farm([Tenant("b", WL, CFG, PLAN)])
+    with pytest.raises(ValueError, match="scheduler owns"):
+        farm.run_farm([Tenant("c", WL, CFG, PLAN, generations=1,
+                              kwargs={"resume": None})])
+
+
+# ---------------------------------------------------------------------------
+# energy
+# ---------------------------------------------------------------------------
+
+
+def test_energy_off_bit_identity_host():
+    # the reproducible default: energy absent / None / uniform all run
+    # the historical frontier-first schedule bit-identically
+    kw = dict(generations=3, batch=16, root_seed=11, max_steps=200,
+              cov_words=8, invariant=_biased_inv)
+    base = explore.run(WL, CFG, PLAN, **kw)
+    off = explore.run(WL, CFG, PLAN, energy=None, **kw)
+    uni = explore.run(
+        WL, CFG, PLAN, energy=EnergySchedule(mode="uniform"), **kw
+    )
+    assert _fp(base) == _fp(off) == _fp(uni)
+    # the adaptive schedule is deterministic (integer weights, threefry
+    # draws on the farm lane) and leaves per-seed semantics intact
+    fast1 = explore.run(WL, CFG, PLAN, energy=EnergySchedule(), **kw)
+    fast2 = explore.run(WL, CFG, PLAN, energy=EnergySchedule(), **kw)
+    assert _fp(fast1) == _fp(fast2)
+
+
+def test_energy_mode_validation():
+    with pytest.raises(ValueError, match="energy mode"):
+        EnergySchedule(mode="bogus").state()
+
+
+def test_energy_weights_decay_and_boost():
+    import numpy as np
+
+    class _E:
+        def __init__(self, id, new_bits, violating, cov):
+            self.id, self.new_bits, self.violating = id, new_bits, violating
+            self.cov = np.asarray(cov, np.uint32)
+
+    # entry 1 violates and owns a rare bit; entry 0 is a plain seed
+    corpus = [
+        _E(0, 2, False, [0b11, 0]),
+        _E(1, 4, True, [0b01, 0b1000]),
+    ]
+    st = EnergySchedule(rare_k=1).state()
+    pool, cum = st.pool(corpus)
+    # frontier order: violating first — entry 1 leads the pool
+    assert [e.id for e in pool] == [1, 0]
+    w = dict(zip((e.id for e in pool),
+                 np.diff(np.concatenate([[0], cum]))))
+    assert w[1] > w[0]  # violation + rare-path bonuses
+    # picking an entry decays its weight next generation
+    st.picks[1] = 8
+    pool2, cum2 = st.pool(corpus)
+    w2 = dict(zip((e.id for e in pool2),
+                  np.diff(np.concatenate([[0], cum2]))))
+    assert w2[1] < w[1] and w2[0] == w[0]
+    assert all(x >= 1 for x in w2.values())  # the floor: nothing starves
+    # the pool respects the frontier depth knob
+    assert len(EnergySchedule(top=1).state().pool(corpus)[0]) == 1
+    # inherit: None defers to the campaign's p; violating floors at 0.9
+    from madsim_tpu.explore.mutate import inherit_threshold
+    assert st.inherit_threshold(corpus[0], 0.8) == inherit_threshold(0.8)
+    assert st.inherit_threshold(corpus[1], 0.8) == inherit_threshold(0.9)
+    assert (EnergySchedule(inherit_seed_p=0.5)
+            .state().inherit_threshold(corpus[0], 0.8)
+            == inherit_threshold(0.5))
+
+
+def test_farm_energy_pick_deterministic_and_bootstrapped():
+    e = FarmEnergy(root_seed=7)
+    names = ["a", "b", "c"]
+    # never-run tenants draw at bootstrap weight; same inputs, same pick
+    p0 = e.pick(0, names, {})
+    assert p0 == e.pick(0, names, {}) and p0 in names
+    # a tenant still finding things dominates two plateaued ones
+    gains = {"a": (0, 0), "b": (40, 2), "c": (0, 0)}
+    picks = {e.pick(i, names, gains) for i in range(16)}
+    assert "b" in picks
+    assert sum(e.pick(i, names, gains) == "b" for i in range(32)) > 16
+    # uniform mode is inert: run_farm falls back to round-robin
+    assert not FarmEnergy(mode="uniform").active
+
+
+# ---------------------------------------------------------------------------
+# flight tagging + the farm dashboard
+# ---------------------------------------------------------------------------
+
+
+def _tools():
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    try:
+        import campaign_top
+    finally:
+        sys.path.pop(0)
+    return campaign_top
+
+
+def test_flight_recorder_tagged_streams():
+    records = []
+    fr = obs.FlightRecorder(records.append, heartbeat_s=0.0,
+                            profile=False, memory=False)
+    a, b = fr.tagged("a"), fr.tagged("b")
+    for sink, g in ((a, 0), (b, 0), (a, 1)):
+        sink({"event": "campaign_start", "generations": 2})
+        sink({"event": "generation", "generation": g, "cov_bits": 1 + g,
+              "corpus_size": 1, "violations": 0})
+    fr.close()
+    gens = [r for r in records if r["event"] == "generation"]
+    assert [g["tenant"] for g in gens] == ["a", "b", "a"]
+    # ONE monotone seq/t_s spine across all tenants
+    assert [r["seq"] for r in records] == list(range(len(records)))
+    # heartbeats inherit the tenant of the generation they follow
+    hbs = [r for r in records if r["event"] == "heartbeat"]
+    assert [h["tenant"] for h in hbs] == ["a", "b", "a"]
+
+
+def test_flight_summary_carries_gen_cache():
+    records = []
+    fr = obs.FlightRecorder(records.append, heartbeat_s=1e9,
+                            profile=False, memory=False)
+    fr({"event": "campaign_start", "generations": 1})
+    fr({"event": "campaign_end"})
+    fr.close()
+    summary = next(r for r in records if r["event"] == "flight_summary")
+    # explore.device is imported by this module: stats must be present
+    assert summary["gen_cache"]["max"] >= 1
+    assert summary["gen_cache"]["evictions"] >= 0
+
+
+def test_campaign_top_farm_dashboard(tmp_path):
+    campaign_top = _tools()
+    path = tmp_path / "farm.jsonl"
+    recs = [
+        {"event": "campaign_start", "generations": 2, "tenant": "halt"},
+        {"event": "generation", "generation": 0, "cov_bits": 40,
+         "corpus_size": 9, "violations": 0, "dispatch_wall_s": 0.2,
+         "sync_wall_s": 0.1, "tenant": "halt"},
+        {"event": "generation", "generation": 0, "cov_bits": 30,
+         "corpus_size": 7, "violations": 3, "dispatch_wall_s": 0.4,
+         "tenant": "biased"},
+        {"event": "campaign_end", "tenant": "halt"},
+        {"event": "flight_summary",
+         "gen_cache": {"entries": 4, "max": 8, "evictions": 1}},
+    ]
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+        fh.write('{"event": "generation", "torn')  # crashed mid-write
+    groups = campaign_top.group_streams([str(path)])
+    assert [g[0] for g in groups] == ["halt", "biased", "(farm)"]
+    frame = campaign_top.render_farm(groups)
+    assert "halt" in frame and "biased" in frame
+    assert "gen cache 4/8" in frame and "1 evictions" in frame
+    # an untagged stream stays on the single-campaign dashboard
+    single = tmp_path / "single.jsonl"
+    single.write_text(json.dumps({"event": "generation", "cov_bits": 1,
+                                  "generation": 0}) + "\n")
+    groups1 = campaign_top.group_streams([str(single)])
+    assert len(groups1) == 1 and groups1[0][1][0]["cov_bits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the generation-program cache LRU (run LAST: it evicts the module's
+# warm programs)
+# ---------------------------------------------------------------------------
+
+
+def test_gen_cache_eviction_and_retrace(monkeypatch):
+    monkeypatch.setenv("MADSIM_GEN_CACHE_MAX", "1")
+    _device._GEN_CACHE.clear()
+    kw = dict(generations=1, batch=16, root_seed=3, max_steps=200,
+              cov_words=8, invariant=_halt_inv)
+    with prof.profiled() as p:
+        r1 = explore.run_device(WL, CFG, PLAN, **kw)
+        s1 = _device.gen_cache_stats()
+        # a second shape evicts the first (capacity 1)...
+        explore.run_device(WL, CFG, PLAN, **{**kw, "batch": 24})
+        s2 = _device.gen_cache_stats()
+        # ...so the first re-traces on return — bit-identically
+        r3 = explore.run_device(WL, CFG, PLAN, **kw)
+        s3 = _device.gen_cache_stats()
+    assert s1 == {"entries": 1, "max": 1, "evictions": s1["evictions"]}
+    assert s2["entries"] == 1
+    assert s3["evictions"] == s1["evictions"] + 2
+    assert _fp(r1) == _fp(r3)
+    retr = p.retraces("explore.device")
+    # generations=1 never breeds: uniform-only, built twice for the
+    # evicted shape, once for the evicting one
+    assert sorted(retr.values()) == [1, 2]
+    with pytest.raises(ValueError, match="MADSIM_GEN_CACHE_MAX"):
+        monkeypatch.setenv("MADSIM_GEN_CACHE_MAX", "zero")
+        _device._gen_cache_max()
+
+
+# ---------------------------------------------------------------------------
+# the full matrix (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_farm_three_tenant_full_matrix():
+    kws = {
+        "halt": dict(invariant=_halt_inv, batch=16, root_seed=11,
+                     max_steps=200, cov_words=8),
+        "biased": dict(invariant=_biased_inv, batch=24, root_seed=5,
+                       max_steps=200, cov_words=8),
+        "wide": dict(invariant=_halt_inv, batch=32, root_seed=2,
+                     max_steps=300, cov_words=16),
+    }
+    refs = {
+        name: explore.run_device(WL, CFG, PLAN, generations=4, **kw)
+        for name, kw in kws.items()
+    }
+    for quantum in (1, 2):
+        for pipeline in (False, True):
+            fr = farm.run_farm(
+                [Tenant(n, WL, CFG, PLAN, generations=4, kwargs=kw)
+                 for n, kw in kws.items()],
+                quantum=quantum, pipeline=pipeline,
+            )
+            for name, ref in refs.items():
+                assert _fp(fr.reports[name]) == _fp(ref), (
+                    f"{name} diverged at quantum={quantum} "
+                    f"pipeline={pipeline}"
+                )
+    # adaptive tenant energy at an equal farm budget still terminates
+    # with every tenant's campaign bit-identical to standalone
+    fr = farm.run_farm(
+        [Tenant(n, WL, CFG, PLAN, generations=4, kwargs=kw)
+         for n, kw in kws.items()],
+        quantum=1, energy=FarmEnergy(root_seed=7),
+    )
+    for name, ref in refs.items():
+        assert _fp(fr.reports[name]) == _fp(ref)

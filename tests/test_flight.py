@@ -49,9 +49,11 @@ WL = make_raft()
 KW = dict(generations=3, batch=16, root_seed=11, max_steps=200,
           cov_words=8, invariant=_halt_inv)
 
-DEVICE_WALL_KEYS = ("dispatch_wall_s", "compile_wall_s", "sync_wall_s")
+DEVICE_WALL_KEYS = ("dispatch_wall_s", "compile_wall_s", "sync_wall_s",
+                    "queue_wall_s", "idle_wall_s")
 HOST_WALL_KEYS = ("dispatch_wall_s", "compile_wall_s", "mutate_wall_s",
-                  "admit_wall_s", "host_wall_s")
+                  "admit_wall_s", "host_wall_s",
+                  "queue_wall_s", "idle_wall_s")
 
 
 def _fp(rep):
@@ -223,12 +225,16 @@ def test_device_generation_records_carry_wall_split():
         for k in DEVICE_WALL_KEYS:
             assert k in g, f"missing {k}"
         assert g["host_syncs"] == 1
+        # the pipeline split exists on BOTH drivers; blocking emits 0s
+        assert g["queue_wall_s"] == 0.0 and g["idle_wall_s"] == 0.0
     # the cold generation paid the build; warm generations are
     # compile-free — the split the old accounting hid inside dispatch
     assert gens[0]["compile_wall_s"] > 0
     assert gens[-1]["compile_wall_s"] == 0.0
     end = next(r for r in recs if r["event"] == "campaign_end")
-    assert {"wall_dispatch_s", "wall_compile_s", "wall_sync_s"} <= set(end)
+    assert {"wall_dispatch_s", "wall_compile_s", "wall_sync_s",
+            "wall_queue_s", "wall_idle_s"} <= set(end)
+    assert end["wall_queue_s"] == 0.0 and end["wall_idle_s"] == 0.0
 
 
 def test_host_generation_records_carry_wall_split(tmp_path):

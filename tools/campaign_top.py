@@ -17,11 +17,21 @@ summary that refreshes in place — the ``top`` for a multi-hour hunt:
 * device memory (live-buffer bytes from heartbeats) and profiled
   program totals from the ``flight_summary`` once the campaign ends.
 
-Usage: python tools/campaign_top.py CAMPAIGN.jsonl [--interval 2]
-                                    [--once]
+With tenant-tagged records (a farm session writing N campaigns
+through one ``FlightRecorder.tagged`` per tenant — see
+``madsim_tpu/farm/``) or with several JSONL paths, the frame becomes
+the farm dashboard: one summary row per (stream, tenant) — progress,
+coverage, corpus, violations, last-slice wall split — plus the shared
+generation-program cache accounting from the flight summary. A stream
+with no tags renders exactly as before.
+
+Usage: python tools/campaign_top.py CAMPAIGN.jsonl [MORE.jsonl ...]
+                                    [--interval 2] [--once]
 
 Reads only; works on live, finished, and crashed (torn last line)
 logs alike. ``--once`` renders a single frame and exits (CI/smoke).
+A multi-stream/multi-tenant tail runs until interrupted (a farm has
+no single campaign_end to wait for).
 """
 
 import argparse
@@ -180,24 +190,105 @@ def render(records: list, path: str = "") -> str:
     return "\n".join(lines)
 
 
+def group_streams(paths) -> list:
+    """Split telemetry paths into renderable (label, records) groups.
+
+    Records carrying a ``"tenant"`` tag (a farm session sharing one
+    recorder) split their stream into one group per tenant, in first-
+    appearance order; untagged records in a tagged stream (the shared
+    flight summary, untagged heartbeats) go to a ``farm`` group only
+    if it would not be the sole group. Untagged single-campaign logs
+    come back as one group — the single-stream dashboard."""
+    groups: list = []
+    for path in paths:
+        records = read_records(path)
+        by_tenant: dict = {}
+        shared = []
+        for r in records:
+            t = r.get("tenant")
+            if t is None:
+                shared.append(r)
+            else:
+                by_tenant.setdefault(t, []).append(r)
+        prefix = f"{path}:" if len(paths) > 1 else ""
+        if not by_tenant:
+            groups.append((f"{prefix}{path}" if not prefix else path,
+                           records))
+        else:
+            for t, recs in by_tenant.items():
+                groups.append((f"{prefix}{t}", recs))
+            if any(r.get("event") == "flight_summary" for r in shared):
+                groups.append((f"{prefix}(farm)", shared))
+    return groups
+
+
+def _tenant_row(label: str, records: list) -> str:
+    gens = [r for r in records if r.get("event") == "generation"]
+    ends = [r for r in records if r.get("event") == "campaign_end"]
+    g = gens[-1] if gens else {}
+    walls = [(k.replace("_wall_s", ""), float(g.get(k, 0.0)))
+             for k in _WALL_KEYS if g.get(k)]
+    total = sum(w for _, w in walls)
+    split = " ".join(f"{n} {w / total:.0%}" for n, w in walls if w > 0) \
+        if total > 0 else "-"
+    slices = len(ends)
+    return (
+        f"  {label:<22} {len(gens):>5} {g.get('cov_bits', '-'):>6} "
+        f"{g.get('corpus_size', '-'):>6} {g.get('violations', '-'):>5} "
+        f"{slices:>6}  {split}"
+    )
+
+
+def render_farm(groups) -> str:
+    """The multi-tenant frame: one row per (stream, tenant) group plus
+    the shared program-cache accounting (pure function, like
+    :func:`render`)."""
+    lines = [
+        "== campaign_top (farm) ==",
+        f"  {'tenant':<22} {'gens':>5} {'cov':>6} {'corpus':>6} "
+        f"{'viol':>5} {'slices':>6}  last-gen wall",
+    ]
+    summary = None
+    for label, records in groups:
+        s = next((r for r in reversed(records)
+                  if r.get("event") == "flight_summary"), None)
+        if s is not None:
+            summary = s
+        if any(r.get("event") == "generation" for r in records):
+            lines.append(_tenant_row(label, records))
+    cache = (summary or {}).get("gen_cache")
+    if cache:
+        lines.append(
+            f"gen cache {cache.get('entries', '?')}/{cache.get('max', '?')} "
+            f"programs resident, {cache.get('evictions', 0)} evictions"
+        )
+    return "\n".join(lines)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="campaign telemetry JSONL to tail")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="campaign telemetry JSONL(s) to tail")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh period seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit")
     args = ap.parse_args()
     while True:
-        records = read_records(args.path)
-        frame = render(records, args.path)
+        groups = group_streams(args.paths)
+        if len(groups) == 1:
+            frame = render(groups[0][1], args.paths[0])
+        else:
+            frame = render_farm(groups)
         if args.once:
             print(frame)
             return 0
         # clear + home, then the frame (plain ANSI keeps deps at zero)
         sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
         sys.stdout.flush()
-        if any(r.get("event") == "campaign_end" for r in records):
+        if len(groups) == 1 and any(
+            r.get("event") == "campaign_end" for r in groups[0][1]
+        ):
             return 0
         time.sleep(args.interval)
 

@@ -1,0 +1,375 @@
+"""Fuzzing-farm soak: the pipelined-driver A/B, the multi-tenant
+scheduler session, and the adaptive-energy hunt. The FARM evidence
+artifact.
+
+Four certificates:
+
+1. **Pipelined >= 1.25x blocking, bit-identical** (the headline). The
+   same device campaign — checkpointing every generation and streaming
+   flight telemetry to JSONL, the host work a real hunt carries — run
+   alternately by blocking ``explore.run_device`` and by
+   ``farm.run_pipelined`` (depth 2), interleaved rounds so box noise
+   hits both sides, in TWO regimes. **Organic**: the campaign's own
+   host work; wall-clock overlap needs a second core (host JSON/numpy
+   work time-slices against XLA's threads on one), so the organic
+   floor applies only when ``os.cpu_count() > 1`` — on a 1-core box
+   the ratio is printed as evidence, not gated. **Loaded**: the
+   telemetry sink carries a per-generation drain latency of 0.6x the
+   measured generation time (an emulated slow collector — blocking
+   I/O wait, the "variable host-side work" the farm exists to absorb;
+   the emulation is disclosed in the artifact). The pipelined driver
+   must absorb the drain (floor 1.25x on EVERY box — I/O wait
+   overlaps device execution even on one core), the blocking driver
+   serializes it. The hard invariants hold across BOTH regimes:
+   corpus / coverage map / violations / the final checkpoint FILE all
+   bit-identical, ``host_syncs`` exactly 1 per generation on both
+   sides (from telemetry), and the ``queue_wall_s``/``idle_wall_s``
+   split in the records shows where the overlap landed.
+2. **3-tenant farm session** — three differently-shaped campaigns
+   (halt invariant / planted trace-bias invariant / wider coverage
+   shape) time-sliced by ``farm.run_farm`` in one-generation quanta
+   over one device set. Every tenant's final campaign equals its
+   standalone run bit-for-bit (preemption IS the checkpoint/resume
+   splice), and the whole session traces every generation program
+   EXACTLY once (profiler-certified ``retraces == 1``; the
+   ``_GEN_CACHE`` holds all tenant programs resident, evictions == 0
+   at the default ``MADSIM_GEN_CACHE_MAX``).
+3. **Adaptive energy >= uniform at equal budget** — on the kvchaos
+   planted lost-write mutant at the needle shape (short horizons, low
+   loss: violations are scarce enough that WHICH parents breed
+   matters; at saturated shapes every frontier entry is equally
+   fertile and the comparison is realization noise — measured, see
+   SCALING.md round 11), the AFLFast-style ``EnergySchedule`` must
+   find at least as many violations as the historical uniform
+   schedule at the SAME total sim budget, aggregated over three root
+   seeds so one lucky realization cannot decide either way. The
+   violation totals per root are printed for the quality claim.
+4. **Energy off is inert** — ``energy=None`` /
+   ``EnergySchedule(mode="uniform")`` replay the no-argument campaign
+   bit-identically on the mutant hunt shape (the farm lane draws never
+   touch the explore mutation stream; the static row lives in
+   tools/lint_soak.py cert 1d).
+
+Usage: python tools/farm_soak.py [batch] [gens] [rounds] > FARM_r11.txt
+       python tools/farm_soak.py --smoke     (tiny sizes, no floors —
+                                              rides `make check`)
+Defaults: batch 1024, gens 6, rounds 3 (generation walls of a few
+hundred ms — the farm regime is many modest generations, and the A/B
+needs enough of them per round for the pipeline split to show).
+Exit 0 iff all four certificates hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore, farm  # noqa: E402
+from madsim_tpu.chaos import (  # noqa: E402
+    CrashStorm,
+    FaultPlan,
+    GrayFailure,
+    PauseStorm,
+)
+from madsim_tpu.check import read_your_writes, stale_reads  # noqa: E402
+from madsim_tpu.engine import EngineConfig  # noqa: E402
+from madsim_tpu.explore import device as _device  # noqa: E402
+from madsim_tpu.farm import EnergySchedule, Tenant  # noqa: E402
+from madsim_tpu.models import make_kvchaos, make_raft  # noqa: E402
+from madsim_tpu.obs import FlightRecorder  # noqa: E402
+from madsim_tpu.obs import prof  # noqa: E402
+
+NODES = (0, 1, 2, 3, 4)
+CFG = EngineConfig(pool_size=64, loss_p=0.02)
+PLAN = FaultPlan((
+    CrashStorm(targets=(1, 2, 3), n=2, t_min_ns=20_000_000,
+               t_max_ns=400_000_000, down_min_ns=50_000_000,
+               down_max_ns=250_000_000),
+    PauseStorm(targets=NODES, n=1, t_min_ns=20_000_000,
+               t_max_ns=300_000_000, down_min_ns=50_000_000,
+               down_max_ns=200_000_000),
+    GrayFailure(targets=NODES, n_links=1),
+), name="farm-soak")
+
+# the kvchaos mutant hunt (the explore/nemesis-soak shape)
+KV_PLAN = FaultPlan((
+    CrashStorm(targets=(1, 2, 3, 4), n=2,
+               t_min_ns=20_000_000, t_max_ns=400_000_000,
+               down_min_ns=50_000_000, down_max_ns=250_000_000),
+), name="kv-nemesis")
+KV_CFG = EngineConfig(pool_size=192, loss_p=0.02)
+KV_STEPS = 800
+KV_CW = 64
+KV_ROOTS = (7, 13, 29)
+
+
+def _cov_inv(view):
+    return view["halted"] | True
+
+
+def _halt_inv(view):
+    return view["halted"]
+
+
+def _biased_inv(view):
+    return (view["trace"] & 7) != 0
+
+
+def _kv_hinv(h):
+    return stale_reads(h) & read_your_writes(h)
+
+
+class _SlowSink:
+    """Emulated slow telemetry collector: each generation record costs
+    ``delay`` seconds of drain latency before reaching the inner sink —
+    blocking I/O wait, the variable host-side work of cert 1's loaded
+    regime (disclosed emulation; the delay is printed)."""
+
+    def __init__(self, inner, delay: float):
+        self.inner, self.delay = inner, delay
+
+    def __call__(self, rec):
+        if rec.get("event") == "generation":
+            time.sleep(self.delay)
+        self.inner(rec)
+
+
+def _fingerprint(rep):
+    return (
+        [(e.id, e.generation, e.parent, e.seed, e.plan.hash(), e.trace,
+          e.new_bits, e.violating) for e in rep.corpus],
+        rep.cov_map.tolist(),
+        [(e.seed, e.trace) for e in rep.violations],
+        rep.curve,
+        rep.viol_curve,
+    )
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    argv = [a for a in sys.argv[1:] if a != "--smoke"]
+    if smoke:
+        batch, gens, rounds = 256, 3, 1
+    else:
+        batch = int(argv[0]) if len(argv) > 0 else 1024
+        gens = int(argv[1]) if len(argv) > 1 else 6
+        rounds = int(argv[2]) if len(argv) > 2 else 3
+    failures = []
+    t_all = time.monotonic()  # lint: allow(wall-clock)
+    print(f"# farm soak{' (smoke)' if smoke else ''}: batch {batch}, "
+          f"{gens} generations, {rounds} rounds, "
+          f"platform={jax.devices()[0].platform}")
+    print(f"# plan {PLAN.hash()} ({PLAN.slots} slots), raft, "
+          f"kv plan {KV_PLAN.hash()}")
+    tmp = tempfile.mkdtemp(prefix="farm_soak_")
+
+    wl = make_raft()  # ONE workload object: program-cache identity
+    kw = dict(generations=gens, batch=batch, root_seed=7, max_steps=256,
+              cov_words=32, invariant=_cov_inv)
+
+    # ---- cert 1: pipelined vs blocking, interleaved A/B ----
+    print("== cert 1: pipelined vs blocking device driver (A/B) ==")
+    # warm the shared programs once (2 gens: uniform AND breed built)
+    # so both sides time pure execution
+    explore.run_device(wl, CFG, PLAN, **{**kw, "generations": 2})
+
+    # the loaded regime's drain latency: 0.6x the measured generation
+    # time, so the sink is heavy but still hideable at depth 2
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    explore.run_device(wl, CFG, PLAN, **kw)
+    gen_wall = (time.monotonic() - t0) / gens  # lint: allow(wall-clock)
+    drain = 0.6 * gen_wall
+    cores = os.cpu_count() or 1
+    print(f"  generation wall {gen_wall * 1000:.0f} ms | loaded-regime "
+          f"drain {drain * 1000:.0f} ms/gen | {cores} core(s)")
+
+    def _campaign(runner, tag, r, delay):
+        ck = os.path.join(tmp, f"{tag}{r}.ckpt")
+        jl = os.path.join(tmp, f"{tag}{r}.jsonl")
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        with FlightRecorder(jl, heartbeat_s=0.0, profile=False) as fr:
+            sink = _SlowSink(fr, delay) if delay else fr
+            rep = runner(wl, CFG, PLAN, telemetry=sink,
+                         checkpoint_path=ck, **kw)
+        wall = time.monotonic() - t0  # lint: allow(wall-clock)
+        recs = [json.loads(line) for line in open(jl)]
+        return rep, wall, ck, recs
+
+    identical = syncs_ok = ckpt_ok = True
+    ratios = {}
+    for regime, delay in (("organic", 0.0), ("loaded", drain)):
+        walls = {"blocking": [], "pipelined": []}
+        queue = idle = 0.0
+        for r in range(rounds):
+            rb, wb, ckb, recb = _campaign(
+                explore.run_device, f"blk-{regime}", r, delay)
+            rp, wp, ckp, recp = _campaign(
+                farm.run_pipelined, f"pipe-{regime}", r, delay)
+            walls["blocking"].append(wb)
+            walls["pipelined"].append(wp)
+            identical &= _fingerprint(rb) == _fingerprint(rp)
+            ckpt_ok &= open(ckb, "rb").read() == open(ckp, "rb").read()
+            for recs in (recb, recp):
+                g = [x for x in recs if x["event"] == "generation"]
+                syncs_ok &= (len(g) == gens
+                             and all(x["host_syncs"] == 1 for x in g))
+            end = next(x for x in recp if x["event"] == "campaign_end")
+            queue, idle = end["wall_queue_s"], end["wall_idle_s"]
+            print(f"  {regime:7} round {r}: blocking {wb:6.2f}s | "
+                  f"pipelined {wp:6.2f}s ({wb / wp:.2f}x) | "
+                  f"queue {queue:.2f}s idle {idle:.2f}s "
+                  f"respec {end['respeculations']}")
+        med_b = statistics.median(walls["blocking"])
+        med_p = statistics.median(walls["pipelined"])
+        ratios[regime] = med_b / med_p
+        print(f"  {regime:7} medians: blocking {gens / med_b:.2f} gens/s "
+              f"vs pipelined {gens / med_p:.2f} gens/s -> "
+              f"{ratios[regime]:.2f}x")
+    # the organic floor needs a second core for the host work to
+    # overlap at all (CPU host work time-slices against XLA on one);
+    # the loaded floor is I/O wait and must overlap on EVERY box
+    organic_ok = smoke or cores == 1 or ratios["organic"] >= 1.25
+    loaded_ok = smoke or ratios["loaded"] >= 1.25
+    if cores == 1 and not smoke:
+        print("  [1-core box] organic wall-clock overlap physically "
+              "unavailable (host compute shares the core with XLA); "
+              "organic ratio reported as evidence, loaded floor gates")
+    ok1 = (identical and syncs_ok and ckpt_ok
+           and organic_ok and loaded_ok)
+    print(f"  bit-identical {identical} | checkpoint files byte-equal "
+          f"{ckpt_ok} | host_syncs 1/gen {syncs_ok} | floors "
+          f"{'none — smoke' if smoke else 'organic 1.25x (multi-core), loaded 1.25x'}")
+    if not ok1:
+        failures.append("pipeline-ab")
+    print(f"cert1 {'PASS' if ok1 else 'FAIL'}")
+
+    # ---- cert 2: the 3-tenant farm session ----
+    print("== cert 2: 3-tenant scheduled session (retraces == 1) ==")
+    tb = max(batch // 4, 16)
+    kws = {
+        "halt": dict(invariant=_halt_inv, batch=tb, root_seed=11,
+                     max_steps=256, cov_words=32),
+        "biased": dict(invariant=_biased_inv, batch=tb + 16, root_seed=5,
+                       max_steps=256, cov_words=32),
+        "wide": dict(invariant=_halt_inv, batch=tb, root_seed=2,
+                     max_steps=384, cov_words=64),
+    }
+    _device._GEN_CACHE.clear()
+    ev0 = _device.gen_cache_stats()["evictions"]
+    with prof.profiled() as p:
+        refs = {
+            n: explore.run_device(wl, CFG, PLAN, generations=gens, **k)
+            for n, k in kws.items()
+        }
+        fl = os.path.join(tmp, "farm.jsonl")
+        with FlightRecorder(fl, heartbeat_s=0.0, profile=False) as fr:
+            t0 = time.monotonic()  # lint: allow(wall-clock)
+            freport = farm.run_farm(
+                [Tenant(n, wl, CFG, PLAN, generations=gens, kwargs=k)
+                 for n, k in kws.items()],
+                quantum=1, telemetry=fr,
+            )
+            fw = time.monotonic() - t0  # lint: allow(wall-clock)
+        retr = p.retraces("explore.device")
+    tenants_ok = all(
+        _fingerprint(freport.reports[n]) == _fingerprint(refs[n])
+        for n in kws
+    )
+    retr_ok = bool(retr) and all(v == 1 for v in retr.values())
+    stats = _device.gen_cache_stats()
+    evictions = stats["evictions"] - ev0
+    recs = [json.loads(line) for line in open(fl)]
+    gen_tags = [x["tenant"] for x in recs if x["event"] == "generation"]
+    tags_ok = (len(gen_tags) == 3 * gens
+               and set(gen_tags) == set(kws))
+    print(f"  {freport.slices} slices in {fw:.1f}s, preemptions "
+          f"{freport.preemptions}")
+    print(f"  scheduled == standalone for all 3 tenants: {tenants_ok}")
+    print(f"  retraces per program key: "
+          f"{sorted(set(retr.values())) if retr else '{}'} (want [1]); "
+          f"cache {stats['entries']}/{stats['max']} entries, "
+          f"{evictions} evictions this session")
+    print(f"  tenant-tagged generation records: {tags_ok} "
+          f"({len(gen_tags)} records)")
+    for line in freport.banner().splitlines():
+        print(f"  {line}")
+    ok2 = tenants_ok and retr_ok and evictions == 0 and tags_ok
+    if not ok2:
+        failures.append("farm-session")
+    print(f"cert2 {'PASS' if ok2 else 'FAIL'}")
+
+    # ---- cert 3: adaptive energy vs uniform at equal budget ----
+    print("== cert 3: adaptive energy vs uniform on the kvchaos mutant ==")
+    # the needle shape: short horizons + low loss make violations
+    # scarce enough that parent choice matters (at saturated shapes the
+    # comparison is realization noise — SCALING.md round 11); the
+    # aggregate over KV_ROOTS keeps one lucky realization from
+    # deciding either way
+    if smoke:
+        kv_gens, kv_batch, kv_roots = 3, 64, (7,)
+    else:
+        kv_gens, kv_batch, kv_roots = 8, 256, KV_ROOTS
+    wl_bug = make_kvchaos(writes=10, record=True, bug=True, chaos=False)
+    ekw = dict(generations=kv_gens, batch=kv_batch,
+               max_steps=KV_STEPS, cov_words=KV_CW, max_ops=1,
+               inherit_seed_p=0.9, history_invariant=_kv_hinv)
+    tot_u = tot_a = 0
+    sims_ok = True
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    for rs in kv_roots:
+        rep_u = explore.run(wl_bug, KV_CFG, KV_PLAN, root_seed=rs, **ekw)
+        rep_a = explore.run(wl_bug, KV_CFG, KV_PLAN, root_seed=rs,
+                            energy=EnergySchedule(), **ekw)
+        sims_ok &= rep_a.sims == rep_u.sims
+        tot_u += len(rep_u.violations)
+        tot_a += len(rep_a.violations)
+        print(f"  root {rs:2}: uniform {len(rep_u.violations):5} | "
+              f"adaptive {len(rep_a.violations):5} violations "
+              f"(cov {rep_u.coverage_bits}/{rep_a.coverage_bits} bits, "
+              f"{rep_u.sims} sims each)")
+    wq = time.monotonic() - t0  # lint: allow(wall-clock)
+    print(f"  aggregate over {len(kv_roots)} root(s): uniform {tot_u} | "
+          f"adaptive {tot_a} violations ({wq:.1f}s)")
+    # the quality floor holds at artifact scale; the smoke shape is too
+    # small for a schedule heuristic to be judged on
+    ok3 = sims_ok and (smoke or tot_a >= tot_u)
+    if not ok3:
+        failures.append("energy-quality")
+    print(f"cert3 {'PASS' if ok3 else 'FAIL'} (equal budget"
+          + ("" if smoke else ", adaptive >= uniform aggregate") + ")")
+
+    # ---- cert 4: energy off is inert ----
+    print("== cert 4: energy off / uniform-mode bit-identity ==")
+    ikw = {**ekw, "generations": min(kv_gens, 3), "root_seed": 7}
+    base = _fingerprint(explore.run(wl_bug, KV_CFG, KV_PLAN, **ikw))
+    off = _fingerprint(explore.run(
+        wl_bug, KV_CFG, KV_PLAN, energy=None, **ikw
+    ))
+    uni = _fingerprint(explore.run(
+        wl_bug, KV_CFG, KV_PLAN, energy=EnergySchedule(mode="uniform"),
+        **ikw
+    ))
+    ok4 = base == off == uni
+    print(f"  absent == None == uniform: {ok4} "
+          f"({len(base[0])} corpus entries, {len(base[2])} violations)")
+    if not ok4:
+        failures.append("energy-identity")
+    print(f"cert4 {'PASS' if ok4 else 'FAIL'}")
+
+    print(f"# total {time.monotonic() - t_all:.1f}s | "  # lint: allow(wall-clock)
+          f"{'ALL PASS' if not failures else 'FAIL: ' + ','.join(failures)}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

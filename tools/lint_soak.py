@@ -29,6 +29,12 @@ Four certificates:
    flat lowerings produce identical traces/pools/histories on a
    chaos-bearing batch, and the carried summaries equal a
    from-scratch ``engine.build_pool_index`` rebuild.
+
+   **1d (dynamic):** the farm non-interference row (ISSUE 16) — the
+   energy machinery draws on its own registered threefry lane, so
+   passing ``energy=None`` / ``EnergySchedule(mode="uniform")`` to
+   ``explore.run`` must be bit-identical to not passing the argument
+   at all: energy off is provably inert, the reproducible default.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
    value-identical op reading a metrics counter into the RNG cursor)
    is caught, with the offending equation chain and the column names.
@@ -180,6 +186,48 @@ def main() -> None:
         print(f"  indexed == flat over {len(_dc.fields(_a)) - 2} fields; "
               f"carried summaries == from-scratch rebuild")
     print(f"cert1c {'PASS' if not (_div or not _sum_ok) else 'FAIL'} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+
+    # ---- certificate 1d: farm energy off is provably inert ----
+    # (ISSUE 16: energy draws live on their own registered threefry
+    # lane; off/uniform must replay the historical schedule exactly)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    print("== cert 1d: farm energy-off bit-identity (dynamic) ==")
+    from madsim_tpu import explore as _explore
+    from madsim_tpu.chaos import FaultPlan as _FaultPlan
+    from madsim_tpu.chaos import PauseStorm as _PauseStorm
+    from madsim_tpu.farm import EnergySchedule as _ES
+
+    _eplan = _FaultPlan((
+        _PauseStorm(targets=(0, 1, 2, 3, 4), n=1, t_min_ns=20_000_000,
+                    t_max_ns=300_000_000, down_min_ns=50_000_000,
+                    down_max_ns=200_000_000),
+    ), name="lint-energy")
+    _ekw = dict(generations=3, batch=16, root_seed=11, max_steps=200,
+                cov_words=8, invariant=lambda v: (v["trace"] & 7) != 0)
+    _ewl = make_raft()
+
+    def _efp(rep):
+        return (
+            [(e.id, e.seed, e.trace, e.new_bits) for e in rep.corpus],
+            rep.cov_map.tolist(),
+            [(e.seed, e.trace) for e in rep.violations],
+            rep.curve, rep.viol_curve,
+        )
+
+    _base = _efp(_explore.run(_ewl, _cfg, _eplan, **_ekw))
+    _off = _efp(_explore.run(_ewl, _cfg, _eplan, energy=None, **_ekw))
+    _uni = _efp(_explore.run(
+        _ewl, _cfg, _eplan, energy=_ES(mode="uniform"), **_ekw
+    ))
+    _energy_ok = _base == _off == _uni
+    if not _energy_ok:
+        failures.append("farm-energy-identity")
+        print("  DIVERGED: energy off/uniform changed the campaign")
+    else:
+        print(f"  absent == None == uniform over {len(_base[0])} corpus "
+              f"entries, {len(_base[2])} violations")
+    print(f"cert1d {'PASS' if _energy_ok else 'FAIL'} "
           f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
 
     # ---- certificate 2: the planted met->step leak is caught ----

@@ -27,14 +27,16 @@ SHELL   := /bin/bash
         bench-smoke bench-tpu-snapshot nemesis-soak explore obs-soak \
         store-soak latency-soak lint lint-soak absint-soak profile clean \
         campaign-bench flight pool-bench pool-bench-smoke \
-        verify-bench verify-bench-smoke farm farm-smoke
+        verify-bench verify-bench-smoke farm farm-smoke \
+        services-models services-models-smoke
 
 check: native lint test determinism bench-smoke flight pool-bench-smoke \
-       verify-bench-smoke farm-smoke
+       verify-bench-smoke farm-smoke services-models-smoke
 	@echo "== make check: all gates passed =="
 
 check-full: native lint test-full determinism bench-smoke flight \
-            pool-bench-smoke verify-bench-smoke farm-smoke
+            pool-bench-smoke verify-bench-smoke farm-smoke \
+            services-models-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -130,6 +132,21 @@ farm:
 
 farm-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/farm_soak.py --smoke
+
+# Service-scale model soak (models/leasekv.py + models/shardkv.py,
+# ISSUE 18): clean-model negatives through the new lease_safety /
+# shard_coverage detectors (numpy == device bit-identical), the
+# grant-after-expiry and release-before-ack mutants found by guided
+# device-resident history hunts (host campaign bit-identical), each
+# find ddmin-shrunk and replayed to the same seed + trace. The
+# SERVICES_MODELS_r12.txt evidence artifact; the smoke (small batches,
+# fewer generations) rides `make check`.
+services-models:
+	$(PY) tools/services_model_soak.py > SERVICES_MODELS_r12.txt; rc=$$?; \
+	    cat SERVICES_MODELS_r12.txt; exit $$rc
+
+services-models-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/services_model_soak.py --smoke
 
 native:
 	$(MAKE) -C native

@@ -66,10 +66,12 @@ from .recorder import Recorder  # noqa: F401
 from .slo import slo_bounded, slo_breaches  # noqa: F401
 from .vectorized import (  # noqa: F401
     election_safety,
+    lease_safety,
     monotonic_reads,
     monotonic_reads_strict,
     read_your_writes,
     recovery_safety,
+    shard_coverage,
     stale_reads,
 )
 
@@ -95,10 +97,12 @@ __all__ = [
     "check_kv",
     "check_register",
     "election_safety",
+    "lease_safety",
     "monotonic_reads",
     "monotonic_reads_strict",
     "read_your_writes",
     "recovery_safety",
+    "shard_coverage",
     "slo_bounded",
     "slo_breaches",
     "stale_reads",

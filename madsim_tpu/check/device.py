@@ -56,11 +56,16 @@ from .history import (
     COL_KEY,
     COL_OK,
     COL_OP,
+    OK_FAIL,
     OK_OK,
     OK_PENDING,
     OP_READ,
     OP_USER,
     OP_WRITE,
+    SHARD_EPOCH_SHIFT,
+    SHARD_GROUP_MASK,
+    SHARD_GROUP_SHIFT,
+    SHARD_VER_MASK,
     BatchHistory,
 )
 
@@ -70,6 +75,7 @@ __all__ = [
     "default_screens",
     "election_safety",
     "fold_verified",
+    "lease_safety",
     "monotonic_reads",
     "monotonic_reads_strict",
     "pack_verdicts",
@@ -78,6 +84,7 @@ __all__ = [
     "recovery_safety",
     "screen_ok",
     "screens_invariant",
+    "shard_coverage",
     "slo_breaches",
     "stale_reads",
     "unpack_verdicts",
@@ -229,6 +236,81 @@ def _recovery_ok(word, count, sync_op: int, recover_op: int):
     return ~jnp.any(rec_m & (last >= 0) & (arg < floor))
 
 
+def _lease_ok(word, count, serve_op: int, lease_op: int):
+    """Per-seed ``lease_safety``: no serve whose latest earlier
+    lifecycle record (same lease) is an expiry, and no expiry below the
+    latest earlier grant's deadline — the same inclusive-running-max
+    construction as the numpy detector, restated pairwise (a serve row
+    is never itself a lifecycle row and an expiry never a grant row, so
+    at-or-before equals strictly-earlier, matching numpy exactly)."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    life = valid & (op == lease_op)
+    grant = life & (ok == OK_OK)
+    expire = life & (ok == OK_FAIL)
+    serve = valid & (op == serve_op) & (ok == OK_OK)
+    same_key = key[:, None] == key[None, :]
+    at_or_before = idx[:, None] <= idx[None, :]
+    # clause 1: the latest same-lease lifecycle record at-or-before
+    # each row, and whether that record is an expiry
+    cand = life[:, None] & same_key & at_or_before
+    last = jnp.max(jnp.where(cand, idx[:, None], jnp.int32(-1)), axis=0)
+    last_exp = jnp.max(
+        jnp.where(cand & expire[:, None], idx[:, None], jnp.int32(-1)),
+        axis=0,
+    )
+    c1 = serve & (last >= 0) & (last_exp == last)
+    # clause 2: expiry clock vs the latest earlier grant's deadline
+    gcand = grant[:, None] & same_key & at_or_before
+    glast = jnp.max(jnp.where(gcand, idx[:, None], jnp.int32(-1)), axis=0)
+    gfloor = jnp.max(
+        jnp.where(
+            gcand & (idx[:, None] == glast[None, :]),
+            arg[:, None],
+            jnp.int64(_MIN),
+        ),
+        axis=0,
+    )
+    c2 = expire & (glast >= 0) & (arg < gfloor)
+    return ~(jnp.any(c1) | jnp.any(c2))
+
+
+def _shard_ok(word, count, own_op: int, write_op: int):
+    """Per-seed ``shard_coverage``: no two installs share (shard,
+    epoch) with different groups, and every install's adopted version
+    covers the running max of earlier committed writes for its shard —
+    same packed-arg decode and same inclusive accumulate as numpy."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    own = valid & (op == own_op) & (ok == OK_OK)
+    write = valid & (op == write_op) & (ok == OK_OK)
+    epoch = arg >> SHARD_EPOCH_SHIFT
+    group = (arg >> SHARD_GROUP_SHIFT) & SHARD_GROUP_MASK
+    ver = arg & SHARD_VER_MASK
+    same_key = key[:, None] == key[None, :]
+    # clause 1: double-serve — pairwise (shard, epoch), groups differ
+    c1 = (
+        own[:, None] & own[None, :] & same_key
+        & (epoch[:, None] == epoch[None, :])
+        & (group[:, None] != group[None, :])
+    )
+    # clause 2: lost range — running max committed version per shard
+    wcand = write[:, None] & same_key & (idx[:, None] <= idx[None, :])
+    wmax = jnp.max(
+        jnp.where(wcand, arg[:, None], jnp.int64(_MIN)), axis=0
+    )
+    c2 = own & (wmax > jnp.int64(_MIN)) & (ver < wmax)
+    return ~(jnp.any(c1) | jnp.any(c2))
+
+
 @dataclasses.dataclass(frozen=True)
 class HistoryScreen:
     """One vectorized detector as a device kernel + its numpy oracle.
@@ -241,8 +323,9 @@ class HistoryScreen:
     names and defaults.
 
     ``op_a``/``op_b`` mean (read, write) for the floor detectors,
-    (elect, -) for election safety and (sync, recover) for recovery
-    safety — exactly the positional ops of the numpy functions.
+    (elect, -) for election safety, (sync, recover) for recovery
+    safety, (serve, lease) for lease safety and (own, write) for shard
+    coverage — exactly the positional ops of the numpy functions.
     """
 
     kind: str
@@ -280,6 +363,12 @@ class HistoryScreen:
             "recovery_safety": lambda: v.recovery_safety(
                 h, self.op_a, self.op_b
             ),
+            "lease_safety": lambda: v.lease_safety(
+                h, self.op_a, self.op_b
+            ),
+            "shard_coverage": lambda: v.shard_coverage(
+                h, self.op_a, self.op_b
+            ),
         }[self.kind]
         return fn()
 
@@ -297,6 +386,8 @@ _KERNELS = {
     "monotonic_reads_strict": lambda w, c, s: _strict_ok(w, c, s.op_a),
     "election_safety": lambda w, c, s: _election_ok(w, c, s.op_a),
     "recovery_safety": lambda w, c, s: _recovery_ok(w, c, s.op_a, s.op_b),
+    "lease_safety": lambda w, c, s: _lease_ok(w, c, s.op_a, s.op_b),
+    "shard_coverage": lambda w, c, s: _shard_ok(w, c, s.op_a, s.op_b),
 }
 
 
@@ -326,6 +417,18 @@ def election_safety(elect_op: int):
 
 def recovery_safety(sync_op: int, recover_op: int):
     return HistoryScreen("recovery_safety", sync_op, recover_op)
+
+
+def lease_safety(serve_op: int, lease_op: int):
+    """Lease-service screen (models/leasekv.py): serve-after-expiry
+    and early-expiry, ``check.vectorized.lease_safety`` on device."""
+    return HistoryScreen("lease_safety", serve_op, lease_op)
+
+
+def shard_coverage(own_op: int, write_op: int):
+    """Shard-migration screen (models/shardkv.py): double-serve and
+    lost-range, ``check.vectorized.shard_coverage`` on device."""
+    return HistoryScreen("shard_coverage", own_op, write_op)
 
 
 def default_screens() -> tuple:

@@ -48,6 +48,11 @@ __all__ = [
     "OP_WRITE",
     "OP_READ",
     "OP_USER",
+    "SHARD_EPOCH_SHIFT",
+    "SHARD_GROUP_SHIFT",
+    "SHARD_GROUP_MASK",
+    "SHARD_VER_MASK",
+    "pack_shard_own",
     "Op",
     "BatchHistory",
     "HistoryError",
@@ -65,6 +70,27 @@ OK_OK = 1  # response: the operation definitely succeeded
 OP_WRITE = 1
 OP_READ = 2
 OP_USER = 16
+
+# Packed arg layout of a shard OWNERSHIP record (models/shardkv.py
+# installs, audited by check.shard_coverage): one int32 arg word
+# carrying (config epoch, owning group, adopted version). This module
+# owns the layout so the recording model and both detector forms
+# (numpy + jnp) cannot drift. epoch <= 2047 and version <= 0xFFFF keep
+# the word positive in int32.
+SHARD_EPOCH_SHIFT = 20
+SHARD_GROUP_SHIFT = 16
+SHARD_GROUP_MASK = 0xF
+SHARD_VER_MASK = 0xFFFF
+
+
+def pack_shard_own(epoch, group, version):
+    """Pack an ownership record's arg word. Works on Python ints,
+    numpy arrays (detectors, tests) and traced values (the model)."""
+    return (
+        (epoch << SHARD_EPOCH_SHIFT)
+        | (group << SHARD_GROUP_SHIFT)
+        | (version & SHARD_VER_MASK)
+    )
 
 
 class HistoryError(ValueError):

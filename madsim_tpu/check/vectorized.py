@@ -43,10 +43,15 @@ from .history import (
     COL_KEY,
     COL_OK,
     COL_OP,
+    OK_FAIL,
     OK_OK,
     OK_PENDING,
     OP_READ,
     OP_WRITE,
+    SHARD_EPOCH_SHIFT,
+    SHARD_GROUP_MASK,
+    SHARD_GROUP_SHIFT,
+    SHARD_VER_MASK,
     BatchHistory,
 )
 
@@ -57,6 +62,8 @@ __all__ = [
     "stale_reads",
     "election_safety",
     "recovery_safety",
+    "lease_safety",
+    "shard_coverage",
 ]
 
 _MIN = np.int64(-(2**62))  # "no prior write" floor sentinel
@@ -251,6 +258,115 @@ def recovery_safety(
         )
         rm = rec_m & (client == c)
         viol |= (rm & (last >= 0) & (arg < floor)).any(axis=1)
+    return ~viol
+
+
+def lease_safety(h: BatchHistory, serve_op: int, lease_op: int) -> np.ndarray:
+    """Lease-service safety (models/leasekv.py): no operation is served
+    through an expired lease, and expiry respects the skew-adjusted TTL
+    contract.
+
+    The workload records the lease LIFECYCLE on ``lease_op`` — a grant
+    or renewal as ``OK_OK`` with arg = the granted deadline (the
+    server's own clock, ms), an expiry as ``OK_FAIL`` with arg = the
+    server's local clock at expiry — and every served operation on
+    ``serve_op``/``OK_OK``, all keyed by lease id. A seed is flagged
+    when:
+
+    1. a serve's latest earlier lifecycle record (same lease) is an
+       expiry — the lease was dead and no re-grant intervened, or
+    2. an expiry's clock arg is below the latest earlier grant's
+       deadline arg — the lease died before its own server's clock
+       reached the deadline it was granted (the TTL contract is stated
+       on the server's LOCAL clock, so honest skew never flags; only a
+       server expiring early against itself does).
+
+    A serve with no earlier lifecycle record constrains nothing
+    (under-flag, not false-flag). Buffer order is dispatch order and
+    all three record kinds come from the single lease server, so
+    "earlier" is the server's own event order — no timestamps needed.
+    """
+    valid, op, key, arg, client, ok = _cols(h)
+    s_dim, h_dim = valid.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    life = valid & (op == lease_op)
+    grant = life & (ok == OK_OK)
+    expire = life & (ok == OK_FAIL)
+    serve = valid & (op == serve_op) & (ok == OK_OK)
+    viol = np.zeros(s_dim, bool)
+    if not life.any():
+        return ~viol
+    idx_row = np.broadcast_to(np.arange(h_dim)[None, :], valid.shape)
+    for k in np.unique(key[life | serve]):
+        lm = life & (key == k)
+        em = expire & (key == k)
+        # clause 1: index of the latest lifecycle record at-or-before
+        # each slot (inclusive accumulate — a serve row is never itself
+        # a lifecycle row, so inclusive == strictly earlier)
+        last_l = np.maximum.accumulate(np.where(lm, idx_row, -1), axis=1)
+        last_is_exp = np.take_along_axis(
+            em.astype(np.int64), np.maximum(last_l, 0), axis=1
+        ) > 0
+        sm = serve & (key == k)
+        viol |= (sm & (last_l >= 0) & last_is_exp).any(axis=1)
+        # clause 2: expiry clock vs the latest earlier grant's deadline
+        gm = grant & (key == k)
+        last_g = np.maximum.accumulate(np.where(gm, idx_row, -1), axis=1)
+        gfloor = np.take_along_axis(
+            np.where(gm, arg, 0), np.maximum(last_g, 0), axis=1
+        )
+        viol |= (em & (last_g >= 0) & (arg < gfloor)).any(axis=1)
+    return ~viol
+
+
+def shard_coverage(h: BatchHistory, own_op: int, write_op: int) -> np.ndarray:
+    """Shard-migration safety (models/shardkv.py): every shard is owned
+    by at most one group per config epoch, and no committed write is
+    lost across a migration.
+
+    The workload records every install on ``own_op``/``OK_OK`` (key =
+    shard, arg = the packed (epoch, group, adopted-version) word —
+    ``history.pack_shard_own``) and every committed write on
+    ``write_op``/``OK_OK`` (key = shard, arg = the version; versions
+    must fit ``SHARD_VER_MASK``). A seed is flagged when:
+
+    1. two install records share (shard, epoch) with different groups —
+       a double-served range, or
+    2. an install's adopted version is below some committed write
+       earlier in the history for that shard — a lost range: the
+       handoff shipped state that predates a committed write.
+
+    Buffer order is dispatch order (deterministic across the fleet), so
+    "earlier" is well-defined without timestamps; a write committed
+    *while* a handoff is legally in flight cannot exist in the clean
+    protocol (the source freezes before handing off), which is exactly
+    why clause 2 is stated over plain buffer order.
+    """
+    valid, op, key, arg, client, ok = _cols(h)
+    s_dim, h_dim = valid.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    own = valid & (op == own_op) & (ok == OK_OK)
+    write = valid & (op == write_op) & (ok == OK_OK)
+    epoch = arg >> SHARD_EPOCH_SHIFT
+    group = (arg >> SHARD_GROUP_SHIFT) & SHARD_GROUP_MASK
+    ver = arg & SHARD_VER_MASK
+    # clause 1: pairwise (shard, epoch) with different groups
+    pair = own[:, :, None] & own[:, None, :]
+    same_key = key[:, :, None] == key[:, None, :]
+    same_ep = epoch[:, :, None] == epoch[:, None, :]
+    diff_g = group[:, :, None] != group[:, None, :]
+    viol = (pair & same_key & same_ep & diff_g).any(axis=(1, 2))
+    # clause 2: per shard, installs vs the running max committed
+    # version (inclusive accumulate — an install row is never itself a
+    # write row, so inclusive == strictly earlier)
+    if own.any() and write.any():
+        for k in np.unique(key[own | write]):
+            wm = write & (key == k)
+            wmax = np.maximum.accumulate(np.where(wm, arg, _MIN), axis=1)
+            om = own & (key == k)
+            viol |= (om & (wmax > _MIN) & (ver < wmax)).any(axis=1)
     return ~viol
 
 

@@ -70,6 +70,7 @@ from .core import (  # noqa: E402,F401
     ABSINT_HORIZON_NS,
     ABSINT_STEP_MAX,
     ColumnContract,
+    StateContract,
     SLOW_MULT_MAX,
     build_pool_index,
     column_contracts,

@@ -688,9 +688,54 @@ class ColumnContract:
     note: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class StateContract:
+    """Declared range of ONE workload state column at step boundaries.
+
+    A workload that declares ``Workload.state_contracts`` (one entry
+    per state column, total over ``state_width``) narrows the
+    ``node_state`` ColumnContract from the full-int32 default to the
+    hull of its declared columns, and TAGS it — so the interval prover
+    (lint.absint) tracks overflow through the workload's own deadline
+    and epoch arithmetic instead of waving it through as
+    "workload-defined words". The contract is assume-guarantee like
+    every loop-carried contract: the prover ASSUMES it at each step
+    entry and the model author owes its truth (clamp what you store).
+    """
+
+    col: int
+    lo: int
+    hi: int
+    family: str | None = None  # "time" | "counter" | None (untracked)
+    note: str = ""
+
+
 def _dtype_full(dt) -> tuple:
     info = np.iinfo(dt)
     return int(info.min), int(info.max)
+
+
+def _node_state_contract(wl: "Workload", i32: tuple) -> "ColumnContract":
+    """The node_state ColumnContract for one workload: full int32 and
+    untracked by default; the hull of the declared per-column ranges
+    (tagged "time" if any declared column is) when the workload ships
+    ``state_contracts``. The hull is the honest join — node_state is
+    one (N, U) array to the prover, so the contract of the array is
+    the union of the contracts of its columns."""
+    if not wl.state_contracts:
+        return ColumnContract(
+            "node_state", *i32, None, "workload-defined words"
+        )
+    lo = min(sc.lo for sc in wl.state_contracts)
+    hi = max(sc.hi for sc in wl.state_contracts)
+    families = {sc.family for sc in wl.state_contracts if sc.family}
+    family = "time" if "time" in families else (
+        "counter" if families else None
+    )
+    return ColumnContract(
+        "node_state", lo, hi, family,
+        f"hull of {len(wl.state_contracts)} declared state columns",
+    )
 
 
 def column_contracts(
@@ -764,7 +809,7 @@ def column_contracts(
         c("alive", 0, 1),
         c("paused", 0, 1),
         c("epoch", 0, cnt, "counter"),
-        c("node_state", *i32, None, "workload-defined words"),
+        _node_state_contract(wl, i32),
         c("clog", 0, 1),
         c("slow", 0, SLOW_MULT_MAX, None, "link latency multiplier"),
         c("dup", 0, 1),
@@ -1381,6 +1426,15 @@ class Workload:
     # (seed, step, purpose) counter per lane), so this is a pure
     # declaration of which lanes to batch; None/() changes nothing.
     draw_purposes: tuple | None = None
+    # per-column range declarations (lint.absint): a tuple of
+    # StateContract, TOTAL over state_width when present. Narrows the
+    # node_state contract in column_contracts() from full int32 to the
+    # hull of the declared columns and tags it, which makes the
+    # interval prover check the workload's own deadline/epoch
+    # arithmetic for overflow. Assume-guarantee: the model owes the
+    # declared bounds (clamp before storing). None (default) keeps the
+    # untracked full-range contract — existing proofs are unchanged.
+    state_contracts: tuple | None = None
 
     def __post_init__(self):
         # emit slot s draws both its latency and loss words from the
@@ -1425,6 +1479,24 @@ class Workload:
             validate_user_purposes(
                 self.draw_purposes, what="Workload.draw_purposes"
             )
+        if self.state_contracts is not None:
+            cols = sorted(sc.col for sc in self.state_contracts)
+            if cols != list(range(self.state_width)):
+                raise ValueError(
+                    f"state_contracts must declare every state column "
+                    f"exactly once (expected cols 0..{self.state_width - 1}, "
+                    f"got {cols}) — a partial declaration would silently "
+                    f"weaken the node_state hull"
+                )
+            bad = [
+                sc.col for sc in self.state_contracts
+                if not (-(2 ** 31) <= sc.lo <= sc.hi <= 2 ** 31 - 1)
+            ]
+            if bad:
+                raise ValueError(
+                    f"state_contracts columns {bad} declare ranges that "
+                    f"are empty or exceed int32"
+                )
         if self.handler_names is not None and len(self.handler_names) != len(
             self.handlers
         ):

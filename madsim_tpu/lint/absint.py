@@ -1419,10 +1419,10 @@ def absint_model_matrix() -> list:
     """(tag, workload, config, horizon_ns) rows from each recorded
     model's own ``absint_entries()`` declaration (models/*.py — the
     range-entry analog of ``lint_entries``)."""
-    from ..models import kvchaos, paxos, raft, raftlog
+    from ..models import kvchaos, leasekv, paxos, raft, raftlog, shardkv
 
     entries = []
-    for mod in (raft, kvchaos, paxos, raftlog):
+    for mod in (raft, kvchaos, paxos, raftlog, leasekv, shardkv):
         for tag, wl, cfg_kw, horizon in mod.absint_entries():
             entries.append((tag, wl, EngineConfig(**cfg_kw), horizon))
     return entries

@@ -520,7 +520,7 @@ CHECK_AXES = {
 }
 
 def model_matrix() -> list:
-    """(name, workload, config) triples for the four recorded models.
+    """(name, workload, config) triples for the six recorded models.
 
     Each model module owns its tracing entry points
     (``models/<name>.py lint_entries()``): every model appears with
@@ -529,10 +529,10 @@ def model_matrix() -> list:
     disk-discipline} axes the acceptance matrix sweeps (build flags
     come from BUILD_AXES).
     """
-    from ..models import kvchaos, paxos, raft, raftlog
+    from ..models import kvchaos, leasekv, paxos, raft, raftlog, shardkv
 
     entries = []
-    for mod in (raft, kvchaos, paxos, raftlog):
+    for mod in (raft, kvchaos, paxos, raftlog, leasekv, shardkv):
         for tag, wl, cfg_kw in mod.lint_entries():
             entries.append((tag, wl, EngineConfig(**cfg_kw)))
     return entries

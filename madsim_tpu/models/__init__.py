@@ -23,6 +23,18 @@ five cover the benchmark configs in BASELINE.md:
   9. snapshot    — Lai-Yang distributed snapshot (consistent cut under
                    message reordering) over a money-transfer workload,
                    with an exact conservation invariant
+
+Service-scale models (ISSUE 18 — the batched analogs of the reference
+ecosystem's service simulators, no C++ oracle, verified by the
+check-package detectors instead):
+
+  10. leasekv    — etcd-style lease/watch KV: TTL leases under
+                   keepalives, server-clock expiry scans (ClockSkew's
+                   spurious-expiry surface) and a sequenced watch
+                   stream with explicit resync
+  11. shardkv    — sharded KV with key-range migration: config epochs,
+                   freeze/handoff/install/release rebalancing, 14
+                   nodes by default (the first N=12+ model)
 """
 
 from .microbench import make_microbench  # noqa: F401
@@ -34,6 +46,8 @@ from .kvchaos import make_kvchaos  # noqa: F401
 from .twophase import make_twophase  # noqa: F401
 from .paxos import make_paxos  # noqa: F401
 from .snapshot import make_snapshot  # noqa: F401
+from .leasekv import make_leasekv  # noqa: F401
+from .shardkv import make_shardkv  # noqa: F401
 
 # The BASELINE.md benchmark configurations, shared by bench.py and
 # examples/cross_backend_check.py so the cross-backend determinism

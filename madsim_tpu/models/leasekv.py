@@ -1,0 +1,539 @@
+"""Lease/watch KV service under chaos (the etcd-shaped batched model).
+
+The batched analog of the reference ecosystem's ``madsim-etcd-client``
+surface (services/etcd.py is the single-seed shim): one lease server,
+``n_clients`` lease-holding clients and one watcher. Each client grants
+itself a TTL lease at the server, keeps it alive with periodic
+heartbeats, and serves puts through it; the server's scan loop expires
+any lease whose deadline passed on the SERVER'S OWN CLOCK and publishes
+the resulting delete events to the watcher as a sequenced stream. Under
+``chaos.ClockSkew`` the server's local expiry clock drifts from true
+time — the classic spurious-expiry bug class — and under loss or
+``Partition`` the watch stream must stay gap-free or explicitly resync
+(the watcher detects a sequence gap and re-syncs against the server's
+stream head, recording the resync marker).
+
+Safety contract (check.lease_safety over ``record=True`` histories):
+
+1. no put is served through a lease whose latest recorded lifecycle
+   event is an expiry (serve-after-expire needs a re-grant first), and
+2. a lease expires only at or after its last granted deadline *on the
+   server's own clock* — the skew-adjusted TTL contract.
+
+Internal chaos kills a random client mid-run (the lease then expires
+server-side and the reborn client must re-grant — the clean
+grant-after-expiry path); composed plans add skew, partitions and
+crash storms on top.
+
+``bug=True`` plants the grant-after-expiry mutant: a keepalive landing
+on an EXPIRED lease silently resurrects it instead of being rejected,
+so later puts are served through a lease the history says is dead —
+visible only to the history checkers (final states look healthy).
+
+Node layout: [server 0, clients 1..C (lease id = node id), watcher C+1]
+Server state:  [deadline_ms(lease 1) .. deadline_ms(lease C),
+                wseq, fin_mask, expire_count]   (0 deadline = no lease)
+Client state:  [granted, acked, fin, 0...]
+Watcher state: [last_wseq, events, resyncs, 0...]
+
+Deadlines are stored in int32 MILLISECONDS of the node's observed
+clock, clamped to the declared certification horizon — the
+``state_contracts`` declaration below is what lets the interval prover
+(lint.absint) check the deadline arithmetic for overflow instead of
+waving node_state through as full-range.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..check.history import OK_FAIL, OK_OK, OP_USER
+from ..engine import (
+    KIND_KILL,
+    KIND_RESTART,
+    HistorySpec,
+    StateContract,
+    Workload,
+    user_kind,
+)
+
+# history op codes (check.lease_safety reads these)
+OP_PUT = OP_USER  # serve: key = lease id, arg = put seq
+OP_EXPIRE = OP_USER + 1  # lifecycle: OK_OK grant (arg = deadline_ms),
+#                          OK_FAIL expiry (arg = server local ms)
+OP_WATCH_EVT = OP_USER + 2  # stream: OK_OK in-order event (arg = wseq),
+#                             OK_FAIL explicit resync (arg = new head)
+
+_H_INIT = 0
+_H_GRANT = 1  # at server: args = (lid,)
+_H_GRANTED = 2  # at client
+_H_KA_T = 3  # at client: keepalive timer
+_H_KEEPALIVE = 4  # at server: args = (lid,)
+_H_KA_REJ = 5  # at client: keepalive hit an expired lease
+_H_SCAN = 6  # at server: expiry scan timer
+_H_PUT_T = 7  # at client: put/progress timer
+_H_PUT = 8  # at server: args = (lid, seq)
+_H_PUT_OK = 9  # at client: args = (seq,)
+_H_PUT_REJ = 10  # at client: put hit an expired lease
+_H_FIN = 11  # at server: args = (lid,)
+_H_WEVT = 12  # at watcher: args = (lid, wseq)
+_H_RESYNC = 13  # at server: watcher stream-head request
+_H_RESYNC_OK = 14  # at watcher: args = (wseq,)
+_H_AREQ = 15  # at watcher: army op arrival — army mode
+_H_APROBE = 16  # at server: army probe
+_H_ARESP = 17  # at watcher: army response
+
+SERVER = 0
+
+_P_KILL_AT = 0
+_P_KILL_WHO = 1
+_P_REVIVE = 2
+
+# Certification horizon in MILLISECONDS for the stored deadline columns:
+# observed clocks are clamped here before any deadline arithmetic, so
+# the declared state contract is owed by construction. 300 sim-seconds
+# matches the model's declared ABSINT_HORIZON_NS.
+HORIZON_MS = 300_000
+# watch-stream sequence cap (the server stops numbering past it; a run
+# certifying more watch events than this is out of contract)
+WSEQ_CAP = (1 << 16) - 1
+# cap on the monotone event/resync/expiry counters
+EVT_CAP = (1 << 16) - 1
+
+
+def _local_ms(now):
+    """The handling node's observed clock in clamped int32 ms.
+
+    ``ctx.now`` is the skew-adjusted view (chaos.ClockSkew lands in it),
+    so expiry deadlines computed from this ARE the node's drifting local
+    clock — exactly the spurious-expiry surface. The clamp keeps every
+    stored deadline inside the declared state contract.
+    """
+    ms = jnp.clip(now // 1_000_000, 0, HORIZON_MS)
+    return ms.astype(jnp.int32)
+
+
+def make_leasekv(
+    n_clients: int = 3,
+    puts: int = 6,
+    ttl_ms: int = 120,
+    ka_ms: int = 40,
+    scan_ms: int = 20,
+    put_ms: int = 30,
+    ka_stop_ms: int | None = None,
+    chaos: bool = True,
+    record: bool = False,
+    hist_capacity: int | None = None,
+    bug: bool = False,
+    army: bool = False,
+    army_probes: int = 1,
+) -> Workload:
+    """``record=True`` turns on the lease lifecycle history: the server
+    records every grant (OP_EXPIRE/OK_OK, arg = the granted deadline in
+    its own ms clock), every expiry (OP_EXPIRE/OK_FAIL, arg = its local
+    ms at expiry) and every served put (OP_PUT/OK_OK); the watcher
+    records in-order stream events and explicit resyncs (OP_WATCH_EVT).
+    Keepalive renewals extend the deadline silently — sound for the
+    detector, because a renewal can only move the deadline LATER than
+    the last recorded grant, and a renewal never follows an expiry on
+    the clean paths (that is precisely what ``bug=True`` breaks).
+
+    ``ka_stop_ms`` makes client 1 stop sending keepalives once its
+    local clock passes that mark (a stalled client) — the knob the
+    dual-mode convergence test drives both arms with.
+
+    ``bug=True`` plants grant-after-expiry: a keepalive on an expired
+    lease resurrects it with no grant record, so subsequent puts are
+    served through a dead lease. Requires ``record=True``.
+
+    ``army=True`` opens the watcher node as an open-loop client
+    surface (``client_army`` builds the spec): ops probe the server's
+    stream head, a read-only path that perturbs scheduling but never
+    protocol state.
+    """
+    n = n_clients + 2
+    watcher = n_clients + 1
+    width = max(n_clients + 3, 4)
+    c_wseq, c_fin_mask, c_exp_cnt = n_clients, n_clients + 1, n_clients + 2
+    full_mask = (1 << n_clients) - 1
+    if bug and not record:
+        raise ValueError(
+            "bug=True plants a fault only histories can see; it requires "
+            "record=True (otherwise nothing would ever detect it)"
+        )
+    if army_probes < 1:
+        raise ValueError(f"army_probes must be >= 1, got {army_probes}")
+    ttl = jnp.int32(ttl_ms)
+
+    def _lid(ctx):
+        return jnp.clip(ctx.args[0], 1, n_clients)
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        is_client = (ctx.node >= 1) & (ctx.node <= jnp.int32(n_clients))
+        is_watcher = ctx.node == jnp.int32(watcher)
+        is_server = ctx.node == jnp.int32(SERVER)
+        # a client (re)grants its lease and starts its timers — at t=0
+        # and again after a restart, the natural rejoin path
+        eb.send(SERVER, user_kind(_H_GRANT), (ctx.node,), when=is_client)
+        eb.after(ka_ms * 1_000_000, user_kind(_H_KA_T), ctx.node,
+                 when=is_client)
+        eb.after(put_ms * 1_000_000, user_kind(_H_PUT_T), ctx.node,
+                 when=is_client)
+        eb.after(scan_ms * 1_000_000, user_kind(_H_SCAN), SERVER,
+                 when=is_server)
+        if chaos:
+            who = ctx.draw.user_int(
+                1, 1 + n_clients, _P_KILL_WHO
+            ).astype(jnp.int32)
+            at = ctx.draw.user_int(20_000_000, 300_000_000, _P_KILL_AT)
+            revive = ctx.draw.user_int(100_000_000, 600_000_000, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=is_watcher)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=is_watcher)
+        return ctx.state, eb.build()
+
+    def on_grant(ctx):
+        # grants and re-grants land here; granting a live lease is a
+        # renewal that records (harmless — it only raises the floor)
+        lid = _lid(ctx)
+        st = ctx.state
+        deadline = _local_ms(ctx.now) + ttl
+        new = st.at[lid - 1].set(deadline)
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_EXPIRE, lid, deadline, ok=OK_OK)
+        eb.send(lid, user_kind(_H_GRANTED), ())
+        return new, eb.build()
+
+    def on_granted(ctx):
+        return ctx.state.at[0].set(1), ctx.emits().build()
+
+    def on_ka_t(ctx):
+        st = ctx.state
+        send = st[0] > 0
+        if ka_stop_ms is not None:
+            # client 1 stalls: its keepalives stop once its own clock
+            # passes the mark (the dual-mode scenario knob)
+            stalled = (ctx.node == jnp.int32(1)) & (
+                _local_ms(ctx.now) >= jnp.int32(ka_stop_ms)
+            )
+            send = send & ~stalled
+        eb = ctx.emits()
+        eb.send(SERVER, user_kind(_H_KEEPALIVE), (ctx.node,), when=send)
+        eb.after(ka_ms * 1_000_000, user_kind(_H_KA_T), ctx.node)
+        return ctx.state, eb.build()
+
+    def on_keepalive(ctx):
+        lid = _lid(ctx)
+        st = ctx.state
+        live = st[lid - 1] > 0
+        deadline = _local_ms(ctx.now) + ttl
+        if bug:
+            # planted grant-after-expiry: the keepalive resurrects an
+            # expired lease with no grant record — puts served through
+            # it look fine in every final state, and only the history
+            # checkers (serve after expiry, no re-grant between) can
+            # see the dead lease serving
+            renew = jnp.bool_(True)
+        else:
+            renew = live
+        new = jnp.where(renew, st.at[lid - 1].set(deadline), st)
+        eb = ctx.emits()
+        eb.send(lid, user_kind(_H_KA_REJ), (), when=~renew)
+        return new, eb.build()
+
+    def on_ka_rej(ctx):
+        # lease expired server-side: drop to ungranted; the put timer
+        # re-grants
+        return ctx.state.at[0].set(0), ctx.emits().build()
+
+    def on_scan(ctx):
+        # the expiry scan: every lease whose deadline passed the
+        # server's OWN clock expires now; each expiry publishes one
+        # sequenced delete event to the watcher
+        st = ctx.state
+        now_ms = _local_ms(ctx.now)
+        wseq = st[c_wseq]
+        eb = ctx.emits()
+        new = st
+        fired = jnp.int32(0)
+        for lid in range(1, n_clients + 1):
+            d = st[lid - 1]
+            exp = (d > 0) & (now_ms >= d)
+            new = jnp.where(exp, new.at[lid - 1].set(0), new)
+            seq_i = jnp.minimum(wseq + fired + 1, jnp.int32(WSEQ_CAP))
+            eb.send(watcher, user_kind(_H_WEVT), (jnp.int32(lid), seq_i),
+                    when=exp)
+            if record:
+                eb.record(OP_EXPIRE, jnp.int32(lid), now_ms, ok=OK_FAIL,
+                          when=exp)
+            fired = fired + exp.astype(jnp.int32)
+        new = new.at[c_wseq].set(
+            jnp.minimum(wseq + fired, jnp.int32(WSEQ_CAP))
+        )
+        new = new.at[c_exp_cnt].set(
+            jnp.minimum(st[c_exp_cnt] + fired, jnp.int32(EVT_CAP))
+        )
+        eb.after(scan_ms * 1_000_000, user_kind(_H_SCAN), SERVER)
+        return new, eb.build()
+
+    def on_put_t(ctx):
+        # the client progress loop: re-grant if ungranted, else push
+        # the next unacked put, else keep offering FIN (all three are
+        # lossy, so all three retry until acknowledged)
+        st = ctx.state
+        granted, acked = st[0] > 0, st[1]
+        done = acked >= jnp.int32(puts)
+        eb = ctx.emits()
+        eb.send(SERVER, user_kind(_H_GRANT), (ctx.node,),
+                when=~granted & ~done)
+        eb.send(SERVER, user_kind(_H_PUT), (ctx.node, acked + 1),
+                when=granted & ~done)
+        eb.send(SERVER, user_kind(_H_FIN), (ctx.node,), when=done)
+        eb.after(put_ms * 1_000_000, user_kind(_H_PUT_T), ctx.node)
+        return ctx.state, eb.build()
+
+    def on_put(ctx):
+        # serve iff the lease is live on the server — the record IS the
+        # serve event check.lease_safety audits
+        lid = _lid(ctx)
+        seq = jnp.clip(ctx.args[1], 0, puts)
+        st = ctx.state
+        live = st[lid - 1] > 0
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_PUT, lid, seq, ok=OK_OK, when=live)
+        eb.send(lid, user_kind(_H_PUT_OK), (seq,), when=live)
+        eb.send(lid, user_kind(_H_PUT_REJ), (), when=~live)
+        return ctx.state, eb.build()
+
+    def on_put_ok(ctx):
+        seq = jnp.clip(ctx.args[0], 0, puts)
+        st = ctx.state
+        return st.at[1].set(jnp.maximum(st[1], seq)), ctx.emits().build()
+
+    def on_put_rej(ctx):
+        return ctx.state.at[0].set(0), ctx.emits().build()
+
+    def on_fin(ctx):
+        lid = _lid(ctx)
+        st = ctx.state
+        mask = st[c_fin_mask] | (jnp.int32(1) << (lid - 1))
+        new = st.at[c_fin_mask].set(mask)
+        eb = ctx.emits()
+        eb.halt(when=mask == jnp.int32(full_mask))
+        return new, eb.build()
+
+    def on_wevt(ctx):
+        # the watch stream: in-order events append; a sequence gap
+        # (lost event) triggers an explicit resync against the server's
+        # stream head — gap-free or resync, never silently skipped
+        lid = jnp.clip(ctx.args[0], 0, n_clients)
+        seq = jnp.clip(ctx.args[1], 0, WSEQ_CAP)
+        st = ctx.state
+        in_order = seq == st[0] + 1
+        gap = seq > st[0] + 1
+        new = jnp.where(in_order, st.at[0].set(seq), st)
+        new = jnp.where(
+            in_order,
+            new.at[1].set(jnp.minimum(new[1] + 1, jnp.int32(EVT_CAP))),
+            new,
+        )
+        new = jnp.where(
+            gap,
+            new.at[2].set(jnp.minimum(new[2] + 1, jnp.int32(EVT_CAP))),
+            new,
+        )
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_WATCH_EVT, lid, seq, ok=OK_OK, when=in_order)
+        eb.send(SERVER, user_kind(_H_RESYNC), (st[0],), when=gap)
+        return new, eb.build()
+
+    def on_resync(ctx):
+        eb = ctx.emits()
+        eb.send(watcher, user_kind(_H_RESYNC_OK), (ctx.state[c_wseq],))
+        return ctx.state, eb.build()
+
+    def on_resync_ok(ctx):
+        # adopt the stream head and record the explicit resync marker
+        # (OK_FAIL on the stream op = "gap resolved by resync")
+        w = jnp.clip(ctx.args[0], 0, WSEQ_CAP)
+        st = ctx.state
+        adv = w > st[0]
+        new = jnp.where(adv, st.at[0].set(w), st)
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_WATCH_EVT, 0, w, ok=OK_FAIL, when=adv)
+        return new, eb.build()
+
+    def on_areq(ctx):
+        # army op arrival at the watcher (a ClientArmy pool row): mark
+        # the invoke and open a k-round probe session against the
+        # server's stream head — read-only, open-loop, no retries
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.lat_start(op_id)
+        eb.send(SERVER, user_kind(_H_APROBE),
+                (op_id, jnp.int32(army_probes - 1)))
+        return ctx.state, eb.build()
+
+    def on_aprobe(ctx):
+        eb = ctx.emits()
+        eb.send(watcher, user_kind(_H_ARESP), (ctx.args[0], ctx.args[1]))
+        return ctx.state, eb.build()
+
+    def on_aresp(ctx):
+        op_id, k = ctx.args[0], ctx.args[1]
+        eb = ctx.emits()
+        eb.send(SERVER, user_kind(_H_APROBE), (op_id, k - 1), when=k > 0)
+        eb.lat_end(op_id, when=k == 0)
+        return ctx.state, eb.build()
+
+    def _cov(ns, now):
+        # protocol coverage: the lease liveness configuration (which
+        # leases are live RIGHT NOW) and the watcher's stream lag —
+        # lease state transitions and stream health are the behaviors
+        # a guided hunt should treat as new, not just event kinds.
+        # uint32 words only (coverage is derived state)
+        live_bits = jnp.uint32(0)
+        for lid in range(1, n_clients + 1):
+            live_bits = live_bits | (
+                (ns[SERVER, lid - 1] > 0).astype(jnp.uint32)
+                << jnp.uint32(lid)
+            )
+        exp = jnp.minimum(ns[SERVER, c_exp_cnt], 15).astype(jnp.uint32)
+        lag = jnp.clip(
+            ns[SERVER, c_wseq] - ns[watcher, 0], 0, 15
+        ).astype(jnp.uint32)
+        f1 = live_bits | (exp << jnp.uint32(8)) | jnp.uint32(1 << 16)
+        f2 = lag | jnp.uint32(1 << 17)
+        return ((f1, jnp.bool_(True)), (f2, jnp.bool_(True)))
+
+    # per-column range contracts (lint.absint): the hull each column is
+    # owed at step boundaries, across every role that uses it. Deadline
+    # columns carry the "time" family so the prover tracks the ms
+    # deadline arithmetic; everything else is a bounded counter.
+    def _sc(col):
+        lo, hi, fam = 0, 1, "counter"
+        ranges = []
+        if col < n_clients:  # server deadline_ms for lease col+1
+            ranges.append((0, HORIZON_MS + ttl_ms, "time"))
+        if col == c_wseq:
+            ranges.append((0, WSEQ_CAP, "counter"))
+        if col == c_fin_mask:
+            ranges.append((0, full_mask, "counter"))
+        if col == c_exp_cnt:
+            ranges.append((0, EVT_CAP, "counter"))
+        if col == 0:  # client granted; watcher last_wseq
+            ranges.append((0, max(1, WSEQ_CAP), "counter"))
+        if col == 1:  # client acked; watcher events
+            ranges.append((0, max(puts, EVT_CAP), "counter"))
+        if col == 2:  # client fin; watcher resyncs
+            ranges.append((0, EVT_CAP, "counter"))
+        for rlo, rhi, rfam in ranges:
+            lo, hi = min(lo, rlo), max(hi, rhi)
+            fam = "time" if rfam == "time" else fam
+        return StateContract(col, lo, hi, fam)
+
+    hist = None
+    if record:
+        cap = (
+            6 * n_clients * max(puts, 2) + 32
+            if hist_capacity is None else hist_capacity
+        )
+        # widest recording dispatch: the scan records one expiry per
+        # lease
+        hist = HistorySpec(capacity=cap, max_records=max(n_clients, 1))
+
+    name = "leasekv"
+    if record:
+        name += "-bug" if bug else "-record"
+    if army:
+        name += "-army"
+    handler_names = (
+        "init", "grant", "granted", "ka_t", "keepalive", "ka_rej",
+        "scan", "put_t", "put", "put_ok", "put_rej", "fin", "wevt",
+        "resync", "resync_ok",
+    )
+    handlers = (
+        on_init, on_grant, on_granted, on_ka_t, on_keepalive, on_ka_rej,
+        on_scan, on_put_t, on_put, on_put_ok, on_put_rej, on_fin,
+        on_wevt, on_resync, on_resync_ok,
+    )
+    if army:
+        handler_names += ("areq", "aprobe", "aresp")
+        handlers += (on_areq, on_aprobe, on_aresp)
+    return Workload(
+        name=name,
+        handler_names=handler_names,
+        n_nodes=n,
+        state_width=width,
+        handlers=handlers,
+        # widest: the scan sends one watch event per lease + its timer;
+        # on_init builds 3 client rows (grant + 2 timers)
+        max_emits=max(n_clients + 1, 6),
+        # largest timer: the chaos restart at 'at + revive' <= 900 ms
+        delay_bound_ns=max(
+            ka_ms * 1_000_000, scan_ms * 1_000_000, put_ms * 1_000_000,
+            900_000_000,
+        ),
+        args_words=2,
+        history=hist,
+        lat_markers=1 if army else 0,
+        cov_features=_cov,
+        state_contracts=tuple(_sc(c) for c in range(width)),
+        draw_purposes=(
+            (_P_KILL_AT, _P_KILL_WHO, _P_REVIVE) if chaos else ()
+        ),
+    )
+
+
+def client_army(
+    n_ops: int = 256,
+    t_min_ns: int = 20_000_000,
+    t_max_ns: int = 400_000_000,
+    n_clients: int = 3,
+    op_base: int = 0,
+):
+    """A :class:`chaos.ClientArmy` bound to leasekv's watcher surface
+    (``make_leasekv(army=True)`` with the same ``n_clients``): ops
+    arrive at the watcher and probe the server's stream head."""
+    from ..chaos.plan import ClientArmy
+
+    return ClientArmy(
+        node=n_clients + 1,  # [server, clients 1..C, watcher C+1]
+        kind=user_kind(_H_AREQ),
+        n_ops=n_ops,
+        t_min_ns=t_min_ns,
+        t_max_ns=t_max_ns,
+        op_base=op_base,
+    )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint): base + record (the new history/coverage columns
+    must prove derived-only) + army (the latency-marker path)."""
+    kw = dict(pool_size=48, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("leasekv/plain", make_leasekv(), kw),
+        ("leasekv/record", make_leasekv(record=True), kw),
+        ("leasekv/army", make_leasekv(army=True), kw),
+    ]
+
+
+# Declared interval-certification horizon (lint.absint): lease TTLs and
+# scan periods are sim-milliseconds; 300 sim-seconds of scan/renewal
+# cycles is generous slack over every recorded leasekv hunt shape, and
+# matches the HORIZON_MS clamp the deadline arithmetic is owed under.
+ABSINT_HORIZON_NS = 300 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): lint_entries rows plus the declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

@@ -1,0 +1,628 @@
+"""Sharded KV with key-range migration under chaos (the first N=12+ model).
+
+A configuration epoch maps ``n_shards`` key ranges onto ``n_groups``
+replica groups (one primary + backups per group); a controller
+rebalances by migrating one shard at a time: freeze the shard at its
+source primary, hand the shard's version state to the destination
+primary, and commit the new epoch only after the destination confirms
+the install — the source keeps (frozen) data until the controller's
+RELEASE, so a kill or lost message mid-migration can stall but never
+lose or double-serve the range. This is the classic lost-shard bug
+class, and with ``n_groups=4, group_size=3`` the fleet is 14 nodes —
+the first model that actually stresses the per-node (N, N) slow and
+partition state the 5-node protocol cores never scale.
+
+Safety contract (check.shard_coverage over ``record=True`` histories):
+
+1. per config epoch, every shard is owned by at most one group (no
+   double-serve): no two install records share (shard, epoch) with
+   different groups, and
+2. no committed write is lost across a migration: every install's
+   adopted version covers every write committed to that shard earlier
+   in the history.
+
+``bug=True`` plants the lost-shard mutant — the migration is "acked"
+before the install is confirmed: the source releases the shard the
+moment it sends the handoff, so a retried handoff (first one lost, or
+the destination killed mid-install) re-sends from the already-wiped
+state and the destination installs version 0, silently dropping every
+committed write — exactly what clause 2 exists to catch.
+
+Node layout: [controller 0, client 1, then group g's replicas at
+2+g*R .. 2+g*R+R-1 (primary first)]
+Primary/backup state: [ver(shard 0..S-1), epoch(shard 0..S-1), frozen]
+Controller state:     [epoch, phase, mig_shard, mig_dst, assign0,
+                       assign1, migs_done, fin_seen] (low columns)
+Client state:         [epoch, acked, fin, -, assign0, assign1]
+
+Shard assignment is packed 4 bits per shard into two 16-bit words
+(``assign0`` shards 0..3, ``assign1`` shards 4..7-style split), so
+S <= 8 groups-of-16 stay inside positive int32. All state columns are
+durable (the nodes model disk-backed servers: a crash is an
+availability + in-flight-message loss, not a RAM wipe), which is what
+makes mid-migration kills recoverable by retry instead of fatal.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..check.history import OK_OK, OP_USER, pack_shard_own
+from ..engine import (
+    KIND_KILL,
+    KIND_RESTART,
+    HistorySpec,
+    StateContract,
+    Workload,
+    user_kind,
+)
+
+# history op codes (check.shard_coverage reads these)
+OP_SHARD_WRITE = OP_USER  # commit: key = shard, arg = version
+OP_SHARD_OWN = OP_USER + 1  # install: key = shard, arg = packed
+#                             (epoch, group, adopted version)
+
+_H_INIT = 0
+_H_PUT_T = 1  # at client: write/progress timer
+_H_WRITE = 2  # at primary: args = (shard, seq)
+_H_REPL = 3  # at backup: args = (shard, ver)
+_H_WRITE_OK = 4  # at client: args = (shard, seq)
+_H_WRONG = 5  # at client: routed to a non-owner — refetch config
+_H_CFG_REQ = 6  # at controller
+_H_CFG = 7  # at client: args = (epoch, assign0, assign1)
+_H_MIG_T = 8  # at controller: rebalance timer
+_H_MIG_RETX = 9  # at controller: re-drive the open migration
+_H_MIG_START = 10  # at src primary: args = (shard, new_epoch, dst)
+_H_HANDOFF = 11  # at dst primary: args = (shard, new_epoch, ver)
+_H_INSTALL_ACK = 12  # at controller: args = (shard, new_epoch)
+_H_RELEASE = 13  # at src primary: args = (shard, new_epoch)
+_H_FIN = 14  # at controller: client done
+_H_AREQ = 15  # at client: army op arrival — army mode
+_H_APROBE = 16  # at controller: army probe
+_H_ARESP = 17  # at client: army response
+
+CONTROLLER = 0
+CLIENT = 1
+
+# controller columns (low state words; groups use the same columns as
+# shard versions — different nodes, the contracts below take the hull)
+_C_EPOCH, _C_PHASE, _C_MIG_S, _C_MIG_D = 0, 1, 2, 3
+_C_A0, _C_A1, _C_DONE, _C_FIN = 4, 5, 6, 7
+# client columns
+_K_EPOCH, _K_ACKED, _K_FIN = 0, 1, 2
+
+_P_KILL_AT = 0
+_P_KILL_WHO = 1
+_P_REVIVE = 2
+
+# contract caps: versions are clamped here on every message arrival,
+# epochs at every bump — the declared state contracts are owed by
+# construction
+VER_CAP = (1 << 16) - 1
+EPOCH_CAP = 255
+_A_MASK = 0xFFFF  # packed-assignment word bound (4 shards x 4 bits)
+
+
+def _initial_assign(n_shards: int, n_groups: int) -> tuple[int, int]:
+    """Initial shard -> group map, packed: shard s starts at s % G."""
+    a0 = a1 = 0
+    for s in range(n_shards):
+        g = s % n_groups
+        if s < 4:
+            a0 |= g << (4 * s)
+        else:
+            a1 |= g << (4 * (s - 4))
+    return a0, a1
+
+
+def make_shardkv(
+    n_groups: int = 4,
+    group_size: int = 3,
+    n_shards: int = 8,
+    writes: int = 16,
+    n_migs: int = 4,
+    put_ms: int = 25,
+    mig_ms: int = 70,
+    retx_ms: int = 40,
+    chaos: bool = True,
+    record: bool = False,
+    hist_capacity: int | None = None,
+    bug: bool = False,
+    army: bool = False,
+    army_probes: int = 1,
+) -> Workload:
+    """``record=True`` records every committed write (OP_SHARD_WRITE,
+    key = shard, arg = version) at the serving primary and every shard
+    install (OP_SHARD_OWN, key = shard, arg = the packed
+    epoch/group/version word) at the installing primary — the two
+    streams check.shard_coverage audits.
+
+    ``bug=True`` plants the lost-shard mutant (release-before-ack, see
+    module docstring). Requires ``record=True``.
+
+    ``army=True`` opens the client node as an open-loop surface
+    (``client_army``): ops probe the controller's config head,
+    read-only.
+    """
+    G, R, S = n_groups, group_size, n_shards
+    n = 2 + G * R
+    if not 1 <= S <= 8:
+        raise ValueError(f"n_shards must be in [1, 8] (packed 4-bit "
+                         f"assignment words), got {S}")
+    if not 1 <= G <= 15:
+        raise ValueError(f"n_groups must be in [1, 15] (4-bit group "
+                         f"ids), got {G}")
+    width = 2 * S + 1
+    c_frozen = 2 * S
+    if width < 8:
+        width = 8  # controller scalars need cols 0..7
+        c_frozen = 2 * S
+    if bug and not record:
+        raise ValueError(
+            "bug=True plants a fault only histories can see; it requires "
+            "record=True (otherwise nothing would ever detect it)"
+        )
+    if army_probes < 1:
+        raise ValueError(f"army_probes must be >= 1, got {army_probes}")
+    a0_init, a1_init = _initial_assign(S, G)
+
+    def _group_of(a0, a1, s):
+        """Shard -> group from the packed words (traced or host).
+
+        The nibble index is ``s & 3`` (== s-4 for shards in the high
+        word), which the interval prover can bound non-negative — a
+        ``where(s < 4, s, s - 4)`` hull would admit a negative shift
+        count and decay the whole read to full range.
+        """
+        w = jnp.where(s < 4, a0, a1)
+        sh = (s & 3) * 4
+        return (w >> sh) & 0xF
+
+    def _primary_of(g):
+        return jnp.int32(2) + g.astype(jnp.int32) * jnp.int32(R)
+
+    def _shard(ctx):
+        return jnp.clip(ctx.args[0], 0, S - 1)
+
+    def on_init(ctx):
+        eb = ctx.emits()
+        is_ctl = ctx.node == jnp.int32(CONTROLLER)
+        is_client = ctx.node == jnp.int32(CLIENT)
+        eb.after(mig_ms * 1_000_000, user_kind(_H_MIG_T), CONTROLLER,
+                 when=is_ctl)
+        eb.after(put_ms * 1_000_000, user_kind(_H_PUT_T), CLIENT,
+                 when=is_client)
+        if chaos:
+            # kill a random PRIMARY mid-run — mid-migration kills are
+            # the schedules the lost-shard class lives in
+            p = ctx.draw.user_int(0, G, _P_KILL_WHO).astype(jnp.int32)
+            who = jnp.int32(2) + p * jnp.int32(R)
+            at = ctx.draw.user_int(20_000_000, 300_000_000, _P_KILL_AT)
+            revive = ctx.draw.user_int(100_000_000, 600_000_000, _P_REVIVE)
+            eb.after(at, KIND_KILL, 0, (who,), when=is_client)
+            eb.after(at + revive, KIND_RESTART, 0, (who,), when=is_client)
+        return ctx.state, eb.build()
+
+    def on_put_t(ctx):
+        # stop-and-wait client: one outstanding write, retried until
+        # acked; writes round-robin the shards (seq k targets shard
+        # k % S, so per-shard versions are strictly increasing)
+        st = ctx.state
+        acked = st[_K_ACKED]
+        done = acked >= jnp.int32(writes)
+        seq = jnp.minimum(acked + 1, jnp.int32(VER_CAP))
+        s = seq % jnp.int32(S)
+        g = _group_of(st[_C_A0], st[_C_A1], s)
+        eb = ctx.emits()
+        eb.send(_primary_of(g), user_kind(_H_WRITE), (s, seq), when=~done)
+        eb.send(CONTROLLER, user_kind(_H_FIN), (), when=done)
+        eb.after(put_ms * 1_000_000, user_kind(_H_PUT_T), CLIENT)
+        return ctx.state, eb.build()
+
+    def on_write(ctx):
+        # serve iff this group owns the shard AND it is not frozen for
+        # an open migration; anything unservable redirects the client
+        # to refetch config. The frozen case MUST redirect too: the
+        # commit-time CFG and RELEASE messages are sent once and lossy,
+        # so a client whose refetch races a migration may retry into a
+        # forever-frozen source — silence there wedges the run
+        s = _shard(ctx)
+        seq = jnp.clip(ctx.args[1], 0, VER_CAP)
+        st = ctx.state
+        owned = st[S + s] > 0
+        frozen = ((st[c_frozen] >> s) & 1) > 0
+        serving = owned & ~frozen
+        fresh = serving & (seq > st[s])
+        new = jnp.where(fresh, st.at[s].set(seq), st)
+        eb = ctx.emits()
+        if record:
+            eb.record(OP_SHARD_WRITE, s, seq, ok=OK_OK, when=fresh)
+        eb.send(CLIENT, user_kind(_H_WRITE_OK), (s, seq), when=serving)
+        eb.send(CLIENT, user_kind(_H_WRONG), (s,), when=~serving)
+        # replicate the committed version inside the group
+        base = jnp.int32(2) + ((ctx.node - 2) // jnp.int32(R)) * jnp.int32(R)
+        for i in range(1, R):
+            eb.send(base + i, user_kind(_H_REPL), (s, seq), when=fresh)
+        return new, eb.build()
+
+    def on_repl(ctx):
+        s = _shard(ctx)
+        v = jnp.clip(ctx.args[1], 0, VER_CAP)
+        st = ctx.state
+        return st.at[s].set(jnp.maximum(st[s], v)), ctx.emits().build()
+
+    def on_write_ok(ctx):
+        seq = jnp.clip(ctx.args[1], 0, VER_CAP)
+        st = ctx.state
+        new = st.at[_K_ACKED].set(jnp.maximum(st[_K_ACKED], seq))
+        return new, ctx.emits().build()
+
+    def on_wrong(ctx):
+        eb = ctx.emits()
+        eb.send(CONTROLLER, user_kind(_H_CFG_REQ), ())
+        return ctx.state, eb.build()
+
+    def on_cfg_req(ctx):
+        st = ctx.state
+        eb = ctx.emits()
+        eb.send(CLIENT, user_kind(_H_CFG),
+                (st[_C_EPOCH], st[_C_A0], st[_C_A1]))
+        return ctx.state, eb.build()
+
+    def on_cfg(ctx):
+        e = jnp.clip(ctx.args[0], 0, EPOCH_CAP)
+        a0 = jnp.clip(ctx.args[1], 0, _A_MASK)
+        a1 = jnp.clip(ctx.args[2], 0, _A_MASK)
+        st = ctx.state
+        adopt = e > st[_K_EPOCH]
+        new = jnp.where(
+            adopt,
+            st.at[_K_EPOCH].set(e).at[_C_A0].set(a0).at[_C_A1].set(a1),
+            st,
+        )
+        return new, ctx.emits().build()
+
+    def _mig_start_row(eb, st, when):
+        """(Re)drive the open migration: idempotent MIG_START to the
+        shard's CURRENT owner (assignment changes only at commit)."""
+        s = st[_C_MIG_S]
+        src = _group_of(st[_C_A0], st[_C_A1], s)
+        new_ep = jnp.minimum(st[_C_EPOCH] + 1, jnp.int32(EPOCH_CAP))
+        eb.send(_primary_of(src), user_kind(_H_MIG_START),
+                (s, new_ep, st[_C_MIG_D]), when=when)
+
+    def on_mig_t(ctx):
+        st = ctx.state
+        idle = st[_C_PHASE] == 0
+        more = st[_C_DONE] < jnp.int32(n_migs)
+        start = idle & more
+        s = st[_C_DONE] % jnp.int32(S)
+        dst = (_group_of(st[_C_A0], st[_C_A1], s) + 1) % jnp.int32(G)
+        new = jnp.where(
+            start,
+            st.at[_C_PHASE].set(1).at[_C_MIG_S].set(s).at[_C_MIG_D].set(dst),
+            st,
+        )
+        eb = ctx.emits()
+        _mig_start_row(eb, new, start)
+        eb.after(retx_ms * 1_000_000, user_kind(_H_MIG_RETX), CONTROLLER,
+                 when=start)
+        eb.after(mig_ms * 1_000_000, user_kind(_H_MIG_T), CONTROLLER,
+                 when=more)
+        return new, eb.build()
+
+    def on_mig_retx(ctx):
+        # the migration makes progress through loss and kills because
+        # the controller re-drives it until the install is confirmed
+        st = ctx.state
+        open_ = st[_C_PHASE] == 1
+        eb = ctx.emits()
+        _mig_start_row(eb, st, open_)
+        eb.after(retx_ms * 1_000_000, user_kind(_H_MIG_RETX), CONTROLLER,
+                 when=open_)
+        return ctx.state, eb.build()
+
+    def on_mig_start(ctx):
+        s = _shard(ctx)
+        new_ep = jnp.clip(ctx.args[1], 0, EPOCH_CAP)
+        dst = jnp.clip(ctx.args[2], 0, G - 1)
+        st = ctx.state
+        owned = st[S + s] > 0
+        eb = ctx.emits()
+        if bug:
+            # planted lost-shard mutant: the source treats "handoff
+            # sent" as "migration done" — it releases the shard
+            # immediately instead of waiting for the controller's
+            # RELEASE, and answers retried MIG_STARTs from the wiped
+            # state. A lost first handoff (or a dst killed
+            # mid-install) then re-hands version 0: the destination's
+            # install adopts a version below the committed writes,
+            # which only check.shard_coverage clause 2 can see.
+            eb.send(_primary_of(dst), user_kind(_H_HANDOFF),
+                    (s, new_ep, st[s]))
+            new = jnp.where(
+                owned,
+                st.at[s].set(0).at[S + s].set(0),
+                st,
+            )
+        else:
+            # freeze and hand off; KEEP the shard until RELEASE — the
+            # retx loop can always re-send the real state
+            eb.send(_primary_of(dst), user_kind(_H_HANDOFF),
+                    (s, new_ep, st[s]), when=owned)
+            new = jnp.where(
+                owned,
+                st.at[c_frozen].set(st[c_frozen] | (jnp.int32(1) << s)),
+                st,
+            )
+        return new, eb.build()
+
+    def on_handoff(ctx):
+        s = _shard(ctx)
+        new_ep = jnp.clip(ctx.args[1], 0, EPOCH_CAP)
+        v = jnp.clip(ctx.args[2], 0, VER_CAP)
+        st = ctx.state
+        fresh = st[S + s] < new_ep
+        ver_new = jnp.maximum(st[s], v)
+        # installing also clears any stale frozen bit for the shard: if
+        # this group's OWN outbound migration of s lost its RELEASE, the
+        # shard coming back supersedes that freeze — keeping it would
+        # leave the new owner permanently unservable
+        new = jnp.where(
+            fresh,
+            st.at[s].set(ver_new).at[S + s].set(new_ep)
+            .at[c_frozen].set(
+                st[c_frozen] & (jnp.int32(_A_MASK) ^ (jnp.int32(1) << s))
+            ),
+            st,
+        )
+        my_group = (ctx.node - 2) // jnp.int32(R)
+        eb = ctx.emits()
+        if record:
+            eb.record(
+                OP_SHARD_OWN, s,
+                pack_shard_own(new_ep, my_group,
+                               jnp.minimum(ver_new, jnp.int32(VER_CAP))),
+                ok=OK_OK, when=fresh,
+            )
+        # always ack (idempotent): a lost ack must not wedge the
+        # migration
+        eb.send(CONTROLLER, user_kind(_H_INSTALL_ACK), (s, new_ep))
+        return new, eb.build()
+
+    def _set_assign(st, s, g):
+        # nibble index via s & 3 (see _group_of): keeps the shift count
+        # provably non-negative for the interval prover. g is clamped to
+        # the nibble it is packed into — a wider value would corrupt the
+        # neighboring shards' assignments
+        sh = (s & 3) * 4
+        g = jnp.clip(g, 0, G - 1)
+        keep = jnp.int32(_A_MASK) ^ (jnp.int32(0xF) << sh)
+        a0 = jnp.where(s < 4, (st[_C_A0] & keep) | (g << sh), st[_C_A0])
+        a1 = jnp.where(s < 4, st[_C_A1], (st[_C_A1] & keep) | (g << sh))
+        return st.at[_C_A0].set(a0).at[_C_A1].set(a1)
+
+    def on_install_ack(ctx):
+        s = _shard(ctx)
+        e = jnp.clip(ctx.args[1], 0, EPOCH_CAP)
+        st = ctx.state
+        match = (
+            (st[_C_PHASE] == 1)
+            & (s == st[_C_MIG_S])
+            & (e == jnp.minimum(st[_C_EPOCH] + 1, jnp.int32(EPOCH_CAP)))
+        )
+        src = _group_of(st[_C_A0], st[_C_A1], s)
+        new = jnp.where(
+            match,
+            _set_assign(st, s, st[_C_MIG_D])
+            .at[_C_EPOCH].set(e)
+            .at[_C_PHASE].set(0)
+            .at[_C_DONE].set(jnp.minimum(st[_C_DONE] + 1,
+                                         jnp.int32(EPOCH_CAP))),
+            st,
+        )
+        eb = ctx.emits()
+        eb.send(_primary_of(src), user_kind(_H_RELEASE), (s, e), when=match)
+        eb.send(CLIENT, user_kind(_H_CFG),
+                (new[_C_EPOCH], new[_C_A0], new[_C_A1]), when=match)
+        eb.halt(
+            when=(new[_C_FIN] > 0) & (new[_C_DONE] >= jnp.int32(n_migs))
+        )
+        return new, eb.build()
+
+    def on_release(ctx):
+        # the committed migration's epilogue: drop the frozen source
+        # copy — the ONLY place a clean source ever forgets a shard
+        s = _shard(ctx)
+        st = ctx.state
+        frozen = ((st[c_frozen] >> s) & 1) > 0
+        new = jnp.where(
+            frozen,
+            st.at[s].set(0).at[S + s].set(0)
+            .at[c_frozen].set(
+                st[c_frozen] & (jnp.int32(_A_MASK) ^ (jnp.int32(1) << s))
+            ),
+            st,
+        )
+        return new, ctx.emits().build()
+
+    def on_fin(ctx):
+        st = ctx.state
+        new = st.at[_C_FIN].set(1)
+        eb = ctx.emits()
+        eb.halt(when=st[_C_DONE] >= jnp.int32(n_migs))
+        return new, eb.build()
+
+    def on_areq(ctx):
+        op_id = ctx.args[0]
+        eb = ctx.emits()
+        eb.lat_start(op_id)
+        eb.send(CONTROLLER, user_kind(_H_APROBE),
+                (op_id, jnp.int32(army_probes - 1)))
+        return ctx.state, eb.build()
+
+    def on_aprobe(ctx):
+        eb = ctx.emits()
+        eb.send(CLIENT, user_kind(_H_ARESP), (ctx.args[0], ctx.args[1]))
+        return ctx.state, eb.build()
+
+    def on_aresp(ctx):
+        op_id, k = ctx.args[0], ctx.args[1]
+        eb = ctx.emits()
+        eb.send(CONTROLLER, user_kind(_H_APROBE), (op_id, k - 1),
+                when=k > 0)
+        eb.lat_end(op_id, when=k == 0)
+        return ctx.state, eb.build()
+
+    def _cov(ns, now):
+        # protocol coverage: the migration epoch edge the controller is
+        # on (epoch, phase, which shard) and the fleet-wide ownership
+        # count — a shard transiently owned by 0 or 2 groups is exactly
+        # the behavior a guided lost-shard hunt should chase. uint32
+        # words only (coverage is derived state)
+        ep = jnp.minimum(ns[CONTROLLER, _C_EPOCH], 255).astype(jnp.uint32)
+        ph = jnp.clip(ns[CONTROLLER, _C_PHASE], 0, 1).astype(jnp.uint32)
+        ms = jnp.clip(ns[CONTROLLER, _C_MIG_S], 0, 7).astype(jnp.uint32)
+        f1 = ep | (ph << jnp.uint32(8)) | (ms << jnp.uint32(9)) \
+            | jnp.uint32(1 << 20)
+        owned = jnp.uint32(0)
+        for g in range(G):
+            p = 2 + g * R
+            for s in range(S):
+                owned = owned + (ns[p, S + s] > 0).astype(jnp.uint32)
+        f2 = jnp.minimum(owned, jnp.uint32(63)) | jnp.uint32(1 << 21)
+        return ((f1, jnp.bool_(True)), (f2, jnp.bool_(True)))
+
+    # per-column contracts (lint.absint): versions and controller
+    # scalars share the low columns across roles, so each column
+    # declares the hull; everything here is a bounded counter
+    def _sc(col):
+        if col < S:  # shard versions
+            hi = VER_CAP
+        elif col < 2 * S:  # per-shard ownership epochs
+            hi = EPOCH_CAP
+        elif col == c_frozen:
+            hi = (1 << S) - 1
+        else:
+            hi = 1
+        if col <= _C_FIN:
+            # controller/client scalars share the low columns with the
+            # group versions; everything they store is <= VER_CAP
+            hi = max(hi, VER_CAP)
+        return StateContract(col, 0, hi, "counter")
+
+    init = np.zeros((n, width), np.int32)
+    init[CONTROLLER, _C_EPOCH] = 1
+    init[CONTROLLER, _C_A0] = a0_init
+    init[CONTROLLER, _C_A1] = a1_init
+    init[CLIENT, _K_EPOCH] = 1
+    init[CLIENT, _C_A0] = a0_init
+    init[CLIENT, _C_A1] = a1_init
+    for s in range(S):
+        init[2 + (s % G) * R, S + s] = 1  # initial owners at epoch 1
+
+    hist = None
+    if record:
+        cap = (
+            2 * writes + 4 * n_migs + 16
+            if hist_capacity is None else hist_capacity
+        )
+        hist = HistorySpec(capacity=cap, max_records=1)
+
+    name = "shardkv"
+    if record:
+        name += "-bug" if bug else "-record"
+    if army:
+        name += "-army"
+    handler_names = (
+        "init", "put_t", "write", "repl", "write_ok", "wrong",
+        "cfg_req", "cfg", "mig_t", "mig_retx", "mig_start", "handoff",
+        "install_ack", "release", "fin",
+    )
+    handlers = (
+        on_init, on_put_t, on_write, on_repl, on_write_ok, on_wrong,
+        on_cfg_req, on_cfg, on_mig_t, on_mig_retx, on_mig_start,
+        on_handoff, on_install_ack, on_release, on_fin,
+    )
+    if army:
+        handler_names += ("areq", "aprobe", "aresp")
+        handlers += (on_areq, on_aprobe, on_aresp)
+    return Workload(
+        name=name,
+        handler_names=handler_names,
+        n_nodes=n,
+        state_width=width,
+        handlers=handlers,
+        # widest: on_write = ok + wrong + (R-1) replications; on_init =
+        # client put timer + 2 chaos rows + controller mig timer
+        max_emits=max(R + 1, 6),
+        init_state=init,
+        # largest timer: the chaos restart at 'at + revive' <= 900 ms
+        delay_bound_ns=max(
+            put_ms * 1_000_000, mig_ms * 1_000_000, retx_ms * 1_000_000,
+            900_000_000,
+        ),
+        args_words=3,
+        # disk-backed servers: every column survives a kill (a crash
+        # is an availability window + message loss, not a RAM wipe) —
+        # which is what makes mid-migration kills retryable
+        durable_cols=tuple(range(width)),
+        history=hist,
+        lat_markers=1 if army else 0,
+        cov_features=_cov,
+        state_contracts=tuple(_sc(c) for c in range(width)),
+        draw_purposes=(
+            (_P_KILL_AT, _P_KILL_WHO, _P_REVIVE) if chaos else ()
+        ),
+    )
+
+
+def client_army(
+    n_ops: int = 256,
+    t_min_ns: int = 20_000_000,
+    t_max_ns: int = 400_000_000,
+    op_base: int = 0,
+):
+    """A :class:`chaos.ClientArmy` bound to shardkv's client surface
+    (``make_shardkv(army=True)``): ops arrive at the client node and
+    probe the controller's config head — read-only."""
+    from ..chaos.plan import ClientArmy
+
+    return ClientArmy(
+        node=CLIENT,
+        kind=user_kind(_H_AREQ),
+        n_ops=n_ops,
+        t_min_ns=t_min_ns,
+        t_max_ns=t_max_ns,
+        op_base=op_base,
+    )
+
+
+def lint_entries():
+    """Tracing entry points for the static non-interference matrix
+    (madsim_tpu.lint): base + record (the new history/coverage columns
+    must prove derived-only) + army (the latency-marker path). The
+    default 14-node shape rides every row — this model exists to
+    stress N=12+."""
+    kw = dict(pool_size=64, loss_p=0.02, clog_backoff_max_ns=2_000_000_000)
+    return [
+        ("shardkv/plain", make_shardkv(), kw),
+        ("shardkv/record", make_shardkv(record=True), kw),
+        ("shardkv/army", make_shardkv(army=True), kw),
+    ]
+
+
+# Declared interval-certification horizon (lint.absint): migrations and
+# write windows are sim-milliseconds; 300 sim-seconds is generous slack
+# over every recorded shardkv hunt shape.
+ABSINT_HORIZON_NS = 300 * 1_000_000_000
+
+
+def absint_entries():
+    """Range-contract entry points for the interval prover
+    (lint.absint): lint_entries rows plus the declared horizon."""
+    return [
+        (tag, wl, kw, ABSINT_HORIZON_NS)
+        for tag, wl, kw in lint_entries()
+    ]

@@ -567,9 +567,10 @@ class TestRepoClean:
         assert res.ok, f"repo lint found:\n{msgs}"
         assert len(res.allowed) > 0  # the checked allowlist is non-empty
 
-    def test_matrix_names_four_recorded_models(self):
+    def test_matrix_names_six_recorded_models(self):
         names = {n.split("/")[0] for n, _wl, _cfg in model_matrix()}
-        assert names == {"raft", "kvchaos", "paxos", "raftlog"}
+        assert names == {"raft", "kvchaos", "paxos", "raftlog",
+                         "leasekv", "shardkv"}
 
 
 class TestSyncEio:

@@ -3,10 +3,11 @@ the repo-wide nondeterminism-leak lint. The LINT evidence artifact.
 
 Four certificates:
 
-1. **Non-interference matrix** — the four recorded models (raft,
-   kvchaos, paxos, raftlog; each with history recording on and off,
-   kvchaos additionally with the client-army latency markers, raftlog
-   additionally with the disk discipline on) x every observability
+1. **Non-interference matrix** — the six recorded models (raft,
+   kvchaos, paxos, raftlog, leasekv, shardkv; each with history
+   recording on and off, kvchaos additionally with the client-army
+   latency markers, raftlog additionally with the disk discipline on,
+   the service models with their own army rows) x every observability
    build axis (base / metrics / timeline / coverage / hit-count /
    latency / all) x every lowering tuple (scatter/int64, dense, time32
    where eligible, and the readiness-indexed pool rows — ISSUE 13:
@@ -35,6 +36,13 @@ Four certificates:
    passing ``energy=None`` / ``EnergySchedule(mode="uniform")`` to
    ``explore.run`` must be bit-identical to not passing the argument
    at all: energy off is provably inert, the reproducible default.
+
+   **1e (dynamic):** the device-detector on/off certificate (ISSUE
+   18) — arming a fused ``check.device`` history screen
+   (``search_seeds(device_check=...)``) is verdict-only: the
+   simulation columns (traces, halt set, histories) are bit-identical
+   to the unarmed host-judged sweep, and the screen's verdict equals
+   the authoritative numpy detector on the unarmed arm's histories.
 2. **Planted-leak positive control** — the ``met -> step`` mutant (one
    value-identical op reading a metrics counter into the RNG cursor)
    is caught, with the offending equation chain and the column names.
@@ -228,6 +236,69 @@ def main() -> None:
         print(f"  absent == None == uniform over {len(_base[0])} corpus "
               f"entries, {len(_base[2])} violations")
     print(f"cert1d {'PASS' if _energy_ok else 'FAIL'} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+
+    # ---- certificate 1e: device detector on/off bit-identity ----
+    # (ISSUE 18: the fused history screens are verdict-only — arming
+    # one must not perturb a single simulation bit, and its verdict
+    # must equal the authoritative numpy detector on the unarmed arm)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    print("== cert 1e: device-detector on/off bit-identity (dynamic) ==")
+    from madsim_tpu.check import device as _dcheck
+    from madsim_tpu.check import lease_safety as _lease_safety
+    from madsim_tpu.engine import search_seeds as _search_seeds
+    from madsim_tpu.models import make_leasekv as _make_leasekv
+    from madsim_tpu.models.leasekv import OP_EXPIRE as _OPE
+    from madsim_tpu.models.leasekv import OP_PUT as _OPP
+
+    _det_ok = True
+    _lcfg = EngineConfig(
+        pool_size=48, loss_p=0.02, clog_backoff_max_ns=2_000_000_000
+    )
+    _lscreens = (_dcheck.lease_safety(_OPP, _OPE),)
+    for _tag, _lkw in (
+        ("clean", dict(record=True)),
+        ("mutant", dict(record=True, bug=True, ttl_ms=50)),
+    ):
+        _lwl = _make_leasekv(**_lkw)
+        _lbox = {}
+
+        def _lhinv(h, _b=_lbox):
+            _b["h"] = h
+            return _np.ones(len(h.count), bool)
+
+        _skw = dict(n_seeds=128, max_steps=2500, require_halt=False)
+        _roff = _search_seeds(
+            _lwl, _lcfg, None, history_invariant=_lhinv, **_skw
+        )
+        _ron = _search_seeds(
+            _lwl, _lcfg, None, device_check=_lscreens, **_skw
+        )
+        _h = _lbox["h"]
+        _host_mask = _lease_safety(_h, _OPP, _OPE)
+        _sim_same = _np.array_equal(_roff.traces, _ron.traces) and \
+            _np.array_equal(_roff.halted, _ron.halted) and \
+            _np.array_equal(_roff.overflowed, _ron.overflowed)
+        _verdict_same = _np.array_equal(_ron.screen_ok, _host_mask)
+        # the escalation payload: exactly the flagged seeds' histories,
+        # bit-identical to the unarmed arm's rows
+        _fl = _ron.flagged_idx
+        _fh = _ron.flagged_history
+        _payload_same = _np.array_equal(
+            _fl, _np.nonzero(~_host_mask & ~_roff.overflowed)[0]
+        ) and _np.array_equal(_fh.count, _h.count[_fl]) and \
+            _np.array_equal(_fh.word, _h.word[_fl])
+        _n_fl = len(_fl)
+        if not (_sim_same and _verdict_same and _payload_same):
+            _det_ok = False
+            print(f"  {_tag}: DIVERGED sim={_sim_same} "
+                  f"verdict={_verdict_same} payload={_payload_same}")
+        else:
+            print(f"  {_tag}: armed == unarmed over 128 seeds "
+                  f"({_n_fl} flagged, payloads bit-identical)")
+    if not _det_ok:
+        failures.append("detector-identity")
+    print(f"cert1e {'PASS' if _det_ok else 'FAIL'} "
           f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
 
     # ---- certificate 2: the planted met->step leak is caught ----

@@ -1,0 +1,262 @@
+"""Service-scale model soak: leasekv + shardkv certificates. The
+SERVICES_MODELS evidence artifact.
+
+The service-scale batched models (models/leasekv.py, models/shardkv.py)
+are verified by the check-package detectors instead of a C++ oracle —
+this soak is their end-to-end evidence chain. Six certificates:
+
+1. **leasekv clean negatives.** The default shape AND the tight-TTL
+   hunt shape (ttl 50 ms vs 40 ms keepalives: a single lost keepalive
+   opens the expiry window) through ``check.lease_safety`` — 0
+   violations, 0 history overflows, every seed halted. The device
+   screen's verdicts equal the numpy detector bit-for-bit on the whole
+   batch.
+2. **shardkv clean negatives.** The default 14-node shape (4 groups x
+   3 replicas, 8 shards, 4 migrations) through
+   ``check.shard_coverage`` — same bars, same numpy == device
+   identity.
+3. **leasekv mutant hunt, device-resident.** The grant-after-expiry
+   mutant (``bug=True``: a keepalive resurrects an expired lease with
+   no grant record) hunted by ``explore.run_device`` with the
+   ``lease_safety`` HistoryScreen traced into the cached generation
+   program. The hunt MUST find violations; the host driver running
+   ``screens_invariant`` over the same campaign is bit-identical
+   (corpus, coverage map, violations).
+4. **leasekv shrink + replay.** The first device find ddmin-shrinks
+   (``chaos.shrink_plan``) and the shrunk (seed, plan) replays to the
+   identical violation and trace hash.
+5. **shardkv mutant hunt, device-resident.** The lost-shard mutant
+   (``bug=True``: the source wipes its copy on handoff send instead of
+   holding it to the release — a retried handoff then ships version-0
+   state) hunted the same way, same bit-identity bar.
+6. **shardkv shrink + replay.** Same bar as cert 4.
+
+Usage: python tools/services_model_soak.py [n_seeds] > SERVICES_MODELS_r12.txt
+       python tools/services_model_soak.py --smoke   (tiny sizes,
+                                                      rides `make check`)
+Exit 0 iff all six certificates hold.
+"""
+
+import _bootstrap  # noqa: F401  (repo root on sys.path)
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from madsim_tpu import explore  # noqa: E402
+from madsim_tpu.chaos import CrashStorm, FaultPlan, shrink_plan  # noqa: E402
+from madsim_tpu.check import device as dc  # noqa: E402
+from madsim_tpu.check import lease_safety, shard_coverage  # noqa: E402
+from madsim_tpu.engine import EngineConfig, search_seeds  # noqa: E402
+from madsim_tpu.models import make_leasekv, make_shardkv  # noqa: E402
+from madsim_tpu.models.leasekv import OP_EXPIRE, OP_PUT  # noqa: E402
+from madsim_tpu.models.shardkv import (  # noqa: E402
+    OP_SHARD_OWN,
+    OP_SHARD_WRITE,
+)
+
+LEASE_CFG = EngineConfig(pool_size=48, loss_p=0.02,
+                         clog_backoff_max_ns=2_000_000_000)
+SHARD_CFG = EngineConfig(pool_size=64, loss_p=0.02,
+                         clog_backoff_max_ns=2_000_000_000)
+LEASE_STEPS = 4000
+SHARD_STEPS = 6000
+
+LEASE_SCREENS = (dc.lease_safety(OP_PUT, OP_EXPIRE),)
+SHARD_SCREENS = (dc.shard_coverage(OP_SHARD_OWN, OP_SHARD_WRITE),)
+
+# hunt spaces: client/primary crash storms — the schedules both bug
+# classes live in (a dead client's lease expires; a mid-migration
+# primary kill exercises the handoff retry the wiped source answers)
+LEASE_PLAN = FaultPlan(
+    (CrashStorm(targets=(1, 2, 3), n=1, t_min_ns=20_000_000,
+                t_max_ns=300_000_000, down_min_ns=100_000_000,
+                down_max_ns=400_000_000),),
+    name="lease-hunt",
+)
+SHARD_PLAN = FaultPlan(
+    (CrashStorm(targets=(2, 5, 8, 11), n=1, t_min_ns=20_000_000,
+                t_max_ns=300_000_000, down_min_ns=100_000_000,
+                down_max_ns=400_000_000),),
+    name="shard-hunt",
+)
+
+
+def _hinv(box, fn, *ops):
+    def inv(h):
+        box["h"] = h
+        box["ok"] = fn(h, *ops)
+        return box["ok"]
+
+    return inv
+
+
+def _clean_cert(tag, builds, cfg, steps, screens, fn, ops, n_seeds):
+    """Clean-negative certificate: every build 0 violations / 0
+    overflows / all halted, and numpy == device verdicts bit-for-bit."""
+    ok = True
+    for name, wl in builds:
+        t0 = time.monotonic()  # lint: allow(wall-clock)
+        box = {}
+        rep = search_seeds(wl, cfg, None, n_seeds=n_seeds,
+                           max_steps=steps,
+                           history_invariant=_hinv(box, fn, *ops))
+        h = box["h"]
+        nv = int((~box["ok"] & ~rep.overflowed).sum())
+        no = int(rep.overflowed.sum())
+        nh = int((~np.asarray(rep.halted)).sum())
+        dev = np.asarray(dc.screen_ok(screens, h.word, h.t, h.count,
+                                      h.drop))
+        ident = bool(np.array_equal(dev, np.asarray(box["ok"])))
+        print(f"  {name}: {nv} violations, {no} overflows, {nh} "
+              f"unhalted, numpy==device {ident} "
+              f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+        ok &= nv == 0 and no == 0 and nh == 0 and ident
+    return ok
+
+
+def _hunt_cert(tag, wl, cfg, steps, plan, screens, fn, ops, batch, gens):
+    """Device hunt certificate: run_device with the HistoryScreen finds
+    the mutant, bit-identical to the host driver; returns the device
+    report for the shrink certificate (None on failure)."""
+    inv = dc.screens_invariant(screens)
+    kw = dict(generations=gens, batch=batch, root_seed=7,
+              max_steps=steps, cov_words=16)
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    host = explore.run(wl, cfg, plan, invariant=None,
+                       history_invariant=inv, **kw)
+    dev = explore.run_device(wl, cfg, plan, invariant=None,
+                             history_check=screens, **kw)
+    identical = (
+        [(e.id, e.seed, e.trace, e.violating, e.plan.hash())
+         for e in host.corpus]
+        == [(e.id, e.seed, e.trace, e.violating, e.plan.hash())
+            for e in dev.corpus]
+        and np.array_equal(host.cov_map, dev.cov_map)
+        and [(e.seed, e.trace) for e in host.violations]
+        == [(e.seed, e.trace) for e in dev.violations]
+    )
+    print(f"  {tag}: {len(dev.violations)} violations over "
+          f"{dev.sims} sims, host==device campaign {identical} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+    if not dev.violations:
+        print(f"  {tag}: HUNT FOUND NOTHING")
+        return None
+    return dev if identical else None
+
+
+def _shrink_cert(tag, wl, cfg, steps, dev, fn, ops):
+    """Shrink + replay certificate over the first device finds."""
+    t0 = time.monotonic()  # lint: allow(wall-clock)
+    results = [
+        shrink_plan(wl, cfg, int(e.seed), e.plan,
+                    history_invariant=_hinv({}, fn, *ops),
+                    max_steps=steps)
+        for e in dev.violations[:3]
+    ]
+    res = min(results, key=lambda r: len(r.events))
+    print("  " + res.banner().replace("\n", "\n  "))
+    box = {}
+    rep = search_seeds(wl, cfg, None, n_seeds=1, max_steps=steps,
+                       seed_base=res.seed,
+                       history_invariant=_hinv(box, fn, *ops),
+                       plan=res.plan)
+    replay_ok = (rep.failing_seeds.tolist() == [res.seed]
+                 and int(rep.traces[0]) == res.trace)
+    print(f"  {tag}: shrink {res.original_events} -> {len(res.events)} "
+          f"events, replay identical violation + trace: {replay_ok} "
+          f"({time.monotonic() - t0:.1f}s)")  # lint: allow(wall-clock)
+    return replay_ok
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    argv = [a for a in sys.argv[1:] if a != "--smoke"]
+    if smoke:
+        n_seeds, batch, gens = 192, 96, 2
+    else:
+        n_seeds = int(argv[0]) if argv else 4096
+        batch, gens = 256, 4
+    failures = []
+    t_all = time.monotonic()  # lint: allow(wall-clock)
+    print(f"# service-scale model soak{' (smoke)' if smoke else ''}: "
+          f"{n_seeds} schedules/clean cert, hunt batch {batch} x "
+          f"{gens} generations, platform={jax.devices()[0].platform}")
+
+    # ---- certificate 1: leasekv clean negatives ----
+    print("== cert 1: leasekv clean (default + tight-TTL hunt shape) ==")
+    ok1 = _clean_cert(
+        "leasekv",
+        [("leasekv/default", make_leasekv(record=True)),
+         ("leasekv/tight", make_leasekv(record=True, ttl_ms=50))],
+        LEASE_CFG, LEASE_STEPS, LEASE_SCREENS, lease_safety,
+        (OP_PUT, OP_EXPIRE), n_seeds,
+    )
+    if not ok1:
+        failures.append("leasekv-clean")
+    print(f"cert1 {'PASS' if ok1 else 'FAIL'}")
+
+    # ---- certificate 2: shardkv clean negatives ----
+    print("== cert 2: shardkv clean (14-node default) ==")
+    ok2 = _clean_cert(
+        "shardkv", [("shardkv/default", make_shardkv(record=True))],
+        SHARD_CFG, SHARD_STEPS, SHARD_SCREENS, shard_coverage,
+        (OP_SHARD_OWN, OP_SHARD_WRITE), n_seeds,
+    )
+    if not ok2:
+        failures.append("shardkv-clean")
+    print(f"cert2 {'PASS' if ok2 else 'FAIL'}")
+
+    # ---- certificates 3+4: leasekv mutant hunt, shrink, replay ----
+    print("== cert 3: leasekv grant-after-expiry hunt (device) ==")
+    wl_lb = make_leasekv(record=True, bug=True, ttl_ms=50)
+    dev_l = _hunt_cert("leasekv-bug", wl_lb, LEASE_CFG, LEASE_STEPS,
+                       LEASE_PLAN, LEASE_SCREENS, lease_safety,
+                       (OP_PUT, OP_EXPIRE), batch, gens)
+    print(f"cert3 {'PASS' if dev_l else 'FAIL'}")
+    if not dev_l:
+        failures.append("leasekv-hunt")
+        print("cert4 SKIP (no find to shrink)")
+        failures.append("leasekv-shrink")
+    else:
+        print("== cert 4: leasekv shrink + replay ==")
+        ok4 = _shrink_cert("leasekv-bug", wl_lb, LEASE_CFG, LEASE_STEPS,
+                           dev_l, lease_safety, (OP_PUT, OP_EXPIRE))
+        if not ok4:
+            failures.append("leasekv-shrink")
+        print(f"cert4 {'PASS' if ok4 else 'FAIL'}")
+
+    # ---- certificates 5+6: shardkv mutant hunt, shrink, replay ----
+    print("== cert 5: shardkv lost-shard hunt (device) ==")
+    wl_sb = make_shardkv(record=True, bug=True)
+    dev_s = _hunt_cert("shardkv-bug", wl_sb, SHARD_CFG, SHARD_STEPS,
+                       SHARD_PLAN, SHARD_SCREENS, shard_coverage,
+                       (OP_SHARD_OWN, OP_SHARD_WRITE), batch, gens)
+    print(f"cert5 {'PASS' if dev_s else 'FAIL'}")
+    if not dev_s:
+        failures.append("shardkv-hunt")
+        print("cert6 SKIP (no find to shrink)")
+        failures.append("shardkv-shrink")
+    else:
+        print("== cert 6: shardkv shrink + replay ==")
+        ok6 = _shrink_cert("shardkv-bug", wl_sb, SHARD_CFG, SHARD_STEPS,
+                           dev_s, shard_coverage,
+                           (OP_SHARD_OWN, OP_SHARD_WRITE))
+        if not ok6:
+            failures.append("shardkv-shrink")
+        print(f"cert6 {'PASS' if ok6 else 'FAIL'}")
+
+    print(f"# total {time.monotonic() - t_all:.1f}s | "  # lint: allow(wall-clock)
+          f"{'ALL PASS' if not failures else 'FAIL: ' + ','.join(failures)}")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

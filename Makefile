@@ -28,15 +28,15 @@ SHELL   := /bin/bash
         store-soak latency-soak lint lint-soak absint-soak profile clean \
         campaign-bench flight pool-bench pool-bench-smoke \
         verify-bench verify-bench-smoke farm farm-smoke \
-        services-models services-models-smoke
+        services-models services-models-smoke causal causal-smoke
 
 check: native lint test determinism bench-smoke flight pool-bench-smoke \
-       verify-bench-smoke farm-smoke services-models-smoke
+       verify-bench-smoke farm-smoke services-models-smoke causal-smoke
 	@echo "== make check: all gates passed =="
 
 check-full: native lint test-full determinism bench-smoke flight \
             pool-bench-smoke verify-bench-smoke farm-smoke \
-            services-models-smoke
+            services-models-smoke causal-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -147,6 +147,22 @@ services-models:
 
 services-models-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/services_model_soak.py --smoke
+
+# Causal-provenance soak (obs/causal.py + the engine causal=True axis,
+# ISSUE 19): the causal-off bit-identity across layouts + compaction at
+# soak scale, device-folded Lamport clocks == host DAG rederivation +
+# the fleet depth/width reduction, cone-vs-ring forensics on a real
+# raftlog election-safety find (conflicting-COMMIT-anchored backward
+# cone <= 25% of the ring, explain(causal=True) narrating the same
+# violation), and the exact-vs-heuristic Perfetto arrow diff under a
+# Duplicate + GrayFailure plan. The CAUSAL_r13.txt evidence artifact;
+# the smoke (tiny sizes, no cone floor) rides `make check`.
+causal:
+	$(PY) tools/causal_soak.py > CAUSAL_r13.txt; rc=$$?; \
+	    cat CAUSAL_r13.txt; exit $$rc
+
+causal-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/causal_soak.py --smoke
 
 native:
 	$(MAKE) -C native

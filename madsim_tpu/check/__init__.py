@@ -60,7 +60,7 @@ from .history import (  # noqa: F401
     Op,
 )
 from . import device  # noqa: F401
-from .device import HistoryScreen  # noqa: F401
+from .device import HistoryScreen, violation_cones  # noqa: F401
 from .linearize import LinResult, check_kv, check_register  # noqa: F401
 from .recorder import Recorder  # noqa: F401
 from .slo import slo_bounded, slo_breaches  # noqa: F401
@@ -106,4 +106,5 @@ __all__ = [
     "slo_bounded",
     "slo_breaches",
     "stale_reads",
+    "violation_cones",
 ]

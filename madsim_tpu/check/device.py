@@ -88,6 +88,7 @@ __all__ = [
     "slo_breaches",
     "stale_reads",
     "unpack_verdicts",
+    "violation_cones",
 ]
 
 _MIN = -(2**62)  # "no prior write" floor sentinel (vectorized._MIN)
@@ -631,6 +632,50 @@ def fold_verified(word, t, count, drop, ok):
         return w2, t2, n_keep, (c - n_keep).astype(c.dtype)
 
     return jax.vmap(per_seed)(word, t, count, drop, ok)
+
+
+def violation_cones(report, wl=None) -> dict:
+    """Causal forensics over a device-screened search's escalation set.
+
+    For every flagged seed in ``report.flagged_idx`` (the Wing–Gong
+    escalation payload of ``search_seeds(device_check=...)``), compute
+    the backward happens-before cone (``obs.causal.causal_slice``)
+    anchored at the seed's last completed history record — the point
+    where the screen's verdict crystallized. The sweep must have run
+    with ``causal=True`` and ``timeline_cap > 0``; the cone then rides
+    the escalation for free (the provenance columns are already in the
+    report), so the host confirmer narrates/replays a small causal
+    slice instead of the whole captured stream.
+
+    Returns ``{seed_row: CausalCone}`` in flagged order. A flagged
+    seed with no completed record anchors at its final dispatch.
+    """
+    from ..obs.causal import causal_slice
+
+    if report.flagged_idx is None:
+        raise ValueError(
+            "report carries no escalation set — run the sweep with "
+            "device_check=... so flagged seeds are identified"
+        )
+    if report.timeline is None:
+        raise ValueError(
+            "violation cones need the captured ring — run the sweep "
+            "with timeline_cap > 0 (and causal=True)"
+        )
+    h = report.flagged_history
+    cones = {}
+    for j, row in enumerate(np.asarray(report.flagged_idx)):
+        anchor = None
+        for i in range(int(h.count[j]) - 1, -1, -1):
+            if int(h.word[j, i, COL_OK]) != OK_PENDING:
+                anchor = (
+                    int(h.t[j, i]), int(h.word[j, i, COL_CLIENT])
+                )
+                break
+        cones[int(row)] = causal_slice(
+            report.timeline, seed=int(row), anchor=anchor, wl=wl
+        )
+    return cones
 
 
 # ---------------------------------------------------------------------------

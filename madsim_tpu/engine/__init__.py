@@ -18,6 +18,7 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .core import (  # noqa: E402,F401
+    CAUSAL_STATE_FIELDS,
     DERIVED_STATE_FIELDS,
     POOL_INDEX_STATE_FIELDS,
     POOL_TILE_CANDIDATES,

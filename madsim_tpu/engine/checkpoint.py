@@ -44,18 +44,20 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # madsim_tpu.chaos disk faults); format 8: the observable fsync-EIO
 # window column (sync_eio, ctx.sync_err); format 9: the tail-latency
 # columns (lat_inv/lat_resp/lat_hist/lat_count/lat_drop) and the
-# emit-time sidecar (ev_emit/tl_emit, madsim_tpu.obs latency). Older
-# checkpoints are rejected with the designed mismatch error rather
-# than a KeyError mid-load.
+# emit-time sidecar (ev_emit/tl_emit, madsim_tpu.obs latency);
+# format 10: the causal-provenance columns (lam/ev_parent/ev_lam and
+# the ring's tl_seq/tl_parent/tl_lam, causal=True) — unlike the pool
+# index these ACCUMULATE (a Lamport clock is history, not a pure
+# function of the pool), so they are part of the format, not rebuilt
+# on load. Older checkpoints are rejected with the designed mismatch
+# error rather than a KeyError mid-load.
 #
 # The readiness-index tile summaries (POOL_INDEX_STATE_FIELDS, ISSUE
 # 13) are NOT part of the format: they are derived by construction
 # (a pure function of ev_time/ev_valid — engine.build_pool_index is
 # the definition), so save() skips them and load() rebuilds them for
-# whatever pool_index resolution the resumed run uses. Format 9 is
-# unchanged — old checkpoints load into indexed runs and new
-# checkpoints load under old readers byte-for-byte.
-_FORMAT = 9
+# whatever pool_index resolution the resumed run uses.
+_FORMAT = 10
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:

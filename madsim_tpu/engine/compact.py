@@ -93,6 +93,14 @@ RESULT_FIELDS = (
     "tl_args",
     "tl_pay",
     "tl_emit",
+    # causal provenance (causal=True): the final Lamport clocks and the
+    # ring's DAG columns bank; the pool-side ev_parent/ev_lam sidecars
+    # do NOT — they are live-pool forensics only readable against a
+    # pool the bank deliberately drops (the lat_inv/lat_resp rule).
+    "lam",
+    "tl_seq",
+    "tl_parent",
+    "tl_lam",
     # tail-latency columns (madsim_tpu.obs latency): the sketch and its
     # counters bank (SLO invariants read lat_hist on compacted runs);
     # the per-op lat_inv/lat_resp clocks do NOT — they are the heavy
@@ -134,6 +142,7 @@ def make_run_compacted(
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
     hist_screen=None,
+    causal: bool = False,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -165,7 +174,7 @@ def make_run_compacted(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool,
+        pool_index, rank_place_max_pool, causal,
     ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:

@@ -129,6 +129,7 @@ __all__ = [
     "DERIVED_STATE_FIELDS",
     "STORAGE_STATE_FIELDS",
     "POOL_INDEX_STATE_FIELDS",
+    "CAUSAL_STATE_FIELDS",
     "derived_fields",
     "core_fields",
     "ColumnContract",
@@ -592,10 +593,24 @@ DERIVED_STATE_FIELDS = (
     # clock, read only into tl_emit — flow-arrow anchoring, never the
     # trajectory
     "ev_emit", "tl_emit",
+    # causal provenance (causal=True): per-node Lamport clocks, the
+    # pool rows' emitting-dispatch seq + emit-time clock, and the ring
+    # columns they bank into — read exclusively to fold more causal
+    # state / the ring, never the trajectory
+    "lam", "ev_parent", "ev_lam", "tl_seq", "tl_parent", "tl_lam",
     # tail-latency columns (LatencySpec): per-op invoke/response clocks
     # and the per-seed log-linear sketch
     "lat_inv", "lat_resp", "lat_hist", "lat_count", "lat_drop",
 )
+
+# ev_parent sentinel classes (causal=True): a pool row whose value is
+# >= 0 was emitted by the dispatch with that event-sequence number
+# (SimState.step at emit time); negative values classify rows with no
+# emitting dispatch. obs.causal treats sentinel-parented events as DAG
+# roots and labels them by class.
+PARENT_NONE = -1  # on_init rows and never-written slots
+PARENT_PLAN = -2  # compiled fault-plan rows (engine/extended-chaos kinds)
+PARENT_ARMY = -3  # client-army plan rows (open-loop USER-kind arrivals)
 
 # the two-phase sync-discipline columns: derived (zero-size) when
 # Workload.durable_sync is off, CORE when it is on — a crash then reads
@@ -611,6 +626,16 @@ STORAGE_STATE_FIELDS = ("disk", "wmask", "sync_loss", "sync_eio", "torn")
 # is the index on/off bit-identity pin. Zero-size when the index is
 # off, the usual discipline.
 POOL_INDEX_STATE_FIELDS = ("tile_min", "tile_cnt")
+
+# the causal-provenance columns (causal=True, ISSUE 19): inside the
+# derived set above, zero-size when the axis is off. Named separately
+# so schema-sensitive consumers (tools/step_goldens.py digests every
+# SimState field name+shape) can keep pre-causal golden digests valid
+# for causal=False builds — the off-state value identity is pinned by
+# tests/test_causal.py, the on-state fold by its rederive pins.
+CAUSAL_STATE_FIELDS = (
+    "lam", "ev_parent", "ev_lam", "tl_seq", "tl_parent", "tl_lam",
+)
 
 
 def derived_fields(wl: "Workload") -> tuple:
@@ -835,6 +860,19 @@ def column_contracts(
         c("tl_pay", *i32),
         c("ev_emit", 0, h, "time"),
         c("tl_emit", 0, h, "time"),
+        # causal columns (causal=True): the Lamport clocks grow by at
+        # most one per dispatch, so the step-count budget bounds them;
+        # parent seqs are clamped copies of `step` with the sentinel
+        # classes below zero (PARENT_ARMY = -3 is the floor)
+        c("lam", 0, ABSINT_STEP_MAX, "counter", "per-node Lamport clock"),
+        c("ev_parent", PARENT_ARMY, ABSINT_STEP_MAX, "counter",
+          "emitting dispatch seq; -1/-2/-3 sentinel classes"),
+        c("ev_lam", 0, ABSINT_STEP_MAX, "counter",
+          "emitting dispatch's Lamport clock"),
+        c("tl_seq", 0, ABSINT_STEP_MAX, "counter", "dispatch seq per row"),
+        c("tl_parent", PARENT_ARMY, ABSINT_STEP_MAX, "counter",
+          "parent seq per row; sentinel classes below zero"),
+        c("tl_lam", 0, ABSINT_STEP_MAX, "counter"),
         c("lat_inv", -1, h, "time", "-1 = never invoked"),
         c("lat_resp", -1, h, "time", "-1 = incomplete"),
         c("lat_hist", 0, cnt, "counter"),
@@ -1617,6 +1655,23 @@ class SimState:
     # Derived state only — read exclusively into the ring.
     ev_emit: jnp.ndarray  # (E,) int64 when the ring is on, else (0,)
     tl_emit: jnp.ndarray  # (T,) int64 emit clock per captured dispatch
+    # causal provenance (causal=True, else all zero-size — the same
+    # derived-state-only discipline). ``lam`` is the node's Lamport
+    # clock, folded at dispatch: lam[dst] = max(lam[dst], lam-at-emit)
+    # + 1. ``ev_parent`` carries each pool row's emitting dispatch's
+    # event-sequence number (SimState.step at emit; sentinel classes
+    # PARENT_NONE/PLAN/ARMY for rows with no emitting dispatch) and
+    # ``ev_lam`` that dispatch's folded clock — both read at pop
+    # exclusively into the ring / the next fold, exactly the ev_emit
+    # pattern. The ring banks the dispatch's own seq (``tl_seq``), its
+    # parent's seq (``tl_parent``) and the folded clock (``tl_lam``),
+    # which is the exact event-derivation DAG obs.causal reconstructs.
+    lam: jnp.ndarray  # (N,) uint32 per-node Lamport clock, else (0,)
+    ev_parent: jnp.ndarray  # (E,) int32 emitting dispatch seq, else (0,)
+    ev_lam: jnp.ndarray  # (E,) uint32 clock at emit, else (0,)
+    tl_seq: jnp.ndarray  # (T,) int32 dispatch seq per captured row
+    tl_parent: jnp.ndarray  # (T,) int32 parent seq per captured row
+    tl_lam: jnp.ndarray  # (T,) uint32 folded clock per captured row
     # tail-latency columns (madsim_tpu.obs latency; C = LatencySpec.ops,
     # 0 when the tap is off — zero-size, zero cost, bit-identical, the
     # cov_words discipline once more). lat_inv/lat_resp are the per-op
@@ -1753,6 +1808,7 @@ def make_init(
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
     pool_index: bool | None = None,
+    causal: bool = False,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -1780,6 +1836,13 @@ def make_init(
     than the crossover threshold), so callers normally pass neither —
     but a caller forcing a non-default ``layout`` on an accelerator
     should pass it explicitly to both, exactly like ``time32``.
+
+    ``causal=True`` sizes the causal-provenance columns (``lam``,
+    ``ev_parent``/``ev_lam`` and — with the ring on — the
+    ``tl_seq``/``tl_parent``/``tl_lam`` ring columns); must match the
+    step builder's value. Plan rows are classed by sentinel at init:
+    engine/chaos rows get :data:`PARENT_PLAN`, client-army USER rows
+    :data:`PARENT_ARMY`, on_init rows :data:`PARENT_NONE`.
     """
     n, u, e, k = wl.n_nodes, wl.state_width, cfg.pool_size, wl.max_emits
     p = plan_slots
@@ -1849,6 +1912,23 @@ def make_init(
             jnp.zeros((e,), jnp.int32),
             jnp.zeros((e,), jnp.int32),
         )
+        if causal:
+            # no pre-seeded row has an emitting dispatch: on_init rows
+            # (and never-written slots) are PARENT_NONE roots; plan rows
+            # are classed engine/chaos vs client-army by the same USER-
+            # kind predicate the epoch sentinel uses above
+            ev_parent = jnp.full((e,), PARENT_NONE, jnp.int32)
+            if p:
+                ev_parent = ev_parent.at[n : n + p].set(
+                    jnp.where(
+                        is_user_row,
+                        jnp.int32(PARENT_ARMY),
+                        jnp.int32(PARENT_PLAN),
+                    )
+                )
+        else:
+            ev_parent = jnp.zeros((0,), jnp.int32)
+        tc_c = timeline_cap if causal else 0
         if n_tiles:
             tile_min, tile_cnt = build_pool_index(ev_time, ev_valid, p_tile)
         else:
@@ -1900,6 +1980,12 @@ def make_init(
             tl_pay=jnp.zeros((timeline_cap, w), jnp.int32),
             ev_emit=jnp.zeros((e if timeline_cap else 0,), jnp.int64),
             tl_emit=jnp.zeros((timeline_cap,), jnp.int64),
+            lam=jnp.zeros((n if causal else 0,), jnp.uint32),
+            ev_parent=ev_parent,
+            ev_lam=jnp.zeros((e if causal else 0,), jnp.uint32),
+            tl_seq=jnp.zeros((tc_c,), jnp.int32),
+            tl_parent=jnp.zeros((tc_c,), jnp.int32),
+            tl_lam=jnp.zeros((tc_c,), jnp.uint32),
             lat_inv=jnp.full((lat_c,), -1, jnp.int64),
             lat_resp=jnp.full((lat_c,), -1, jnp.int64),
             lat_hist=jnp.zeros((lat_p, N_LAT_BUCKETS if lat_c else 0), jnp.int32),
@@ -1994,6 +2080,7 @@ def make_step(
     placement: str | None = None,
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
+    causal: bool = False,
     _lat_export: bool = False,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
@@ -2115,6 +2202,16 @@ def make_step(
       folds a (window, latency-bucket) feature, so "the tail moved"
       is new coverage the guided hunt can chase. Out-of-range op ids
       count loudly in ``lat_drop``.
+    * ``causal=True`` folds exact causal provenance: each dispatch's
+      event-sequence number (``SimState.step`` at dispatch) becomes the
+      ``ev_parent`` of every event it emits, the destination node's
+      Lamport clock folds ``lam[dst] = max(lam[dst], lam_at_emit) + 1``,
+      and — with the ring on — each captured row banks its own seq
+      (``tl_seq``), its parent's seq (``tl_parent``) and the folded
+      clock (``tl_lam``): the exact event-derivation DAG, decoded by
+      ``obs.causal``. When coverage is also on, each dispatch folds a
+      (Lamport-depth bucket, cross-node-jump bucket) feature, so deeper
+      or wider causality is new coverage.
     """
     n = wl.n_nodes
     k = wl.max_emits
@@ -2307,6 +2404,14 @@ def make_step(
                 f"(auto-resolution is backend-dependent, the time32 "
                 f"rule)"
             )
+        # causal shape guard (the same trace-time rule): a causal step
+        # folding zero-size clock columns would be silently wrong
+        if causal and st.lam.shape[0] != n:
+            raise TypeError(
+                f"SimState.lam has shape {st.lam.shape} but this step "
+                f"was built with causal=True (expects ({n},)); build "
+                f"init/step with matching causal= values"
+            )
         # ---- pop the earliest pending event (the timer-jump of
         # time/mod.rs:45-60 merged with the ready-queue drain) ----
         # Two value-identical lowerings of every per-event read/write
@@ -2379,6 +2484,15 @@ def make_step(
         # emit-time sidecar (ring on): when THIS event entered the pool
         # — read before placement can reuse the freed slot
         emit_i = pick_slot(st.ev_emit) if timeline_cap else jnp.int64(0)
+        if causal:
+            # causal sidecar: the popped row's emitting-dispatch seq and
+            # the clock that dispatch folded — the same read-before-
+            # placement rule as emit_i
+            parent_i = pick_slot(st.ev_parent)
+            evlam_i = pick_slot(st.ev_lam)
+        else:
+            parent_i = jnp.int32(PARENT_NONE)
+            evlam_i = jnp.uint32(0)
         # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too:
         # dispatched inline, exempt from the epoch/pause gates
         is_engine = (kind < FIRST_USER_KIND) | (kind >= FIRST_EXT_KIND)
@@ -2441,6 +2555,43 @@ def make_step(
         held = (~is_engine) & paused_dst
         blocked = clogged | held
         dispatch = active & ~blocked & (is_engine | live)
+
+        # ---- causal provenance fold (causal=True; derived state only,
+        # the ev_emit discipline: everything below is read exclusively
+        # into more causal columns / the ring, never the trajectory) ----
+        if causal:
+            # the dispatch's event-sequence number, int32 for the
+            # sentinel classes: `step` is certified <= ABSINT_STEP_MAX
+            # (= 2^31, one past int32), so the clamp makes the narrow
+            # cast provably wrap-free — and a clamped seq can only occur
+            # past the certified run length anyway
+            seq_i32 = jnp.minimum(
+                st.step, jnp.uint32(ABSINT_STEP_MAX - 1)
+            ).astype(jnp.int32)
+            if dense:
+                lam_prev = jnp.sum(
+                    jnp.where(dst_oh, st.lam, jnp.uint32(0))
+                ).astype(jnp.uint32)
+            else:
+                lam_prev = jnp.where(
+                    in_range, st.lam[dst_c], jnp.uint32(0)
+                )
+            # the Lamport fold: receive = max(own, sender's) + 1. An
+            # undelivered step (no dispatch) folds nothing.
+            lam_new = jnp.maximum(lam_prev, evlam_i) + jnp.uint32(1)
+            if dense or rank_place:
+                lam = jnp.where(
+                    dst_oh & dispatch, lam_new, st.lam
+                ).astype(jnp.uint32)
+            else:
+                # OOB dst writes nothing, the dropped-scatter rule
+                lam = st.lam.at[
+                    jnp.where(dispatch & in_range, dst_c, jnp.int32(n))
+                ].set(lam_new, mode="drop")
+        else:
+            seq_i32 = jnp.int32(0)
+            lam_prev = lam_new = jnp.uint32(0)
+            lam = st.lam
 
         now = jnp.where(active, ev_t, st.now)
         draw = Draw(st.seed, st.step)
@@ -2943,6 +3094,18 @@ def make_step(
                 )
             else:
                 ev_emit = st.ev_emit
+            if causal:
+                # every inserted event's parent is THIS dispatch; a
+                # rescheduled row keeps its original parent (a retry is
+                # not a new derivation — the emit-time rule again)
+                ev_parent = place(
+                    jnp.broadcast_to(seq_i32, (k1,)), st.ev_parent
+                )
+                ev_lam = place(
+                    jnp.broadcast_to(lam_new, (k1,)), st.ev_lam
+                )
+            else:
+                ev_parent, ev_lam = st.ev_parent, st.ev_lam
         elif rank_place and not pool_index:
             # rank-matched vector placement: the free slots are the
             # ready-to-receive partition of the pool, ranked in slot
@@ -2999,6 +3162,13 @@ def make_step(
                 ev_emit = jnp.where(take, now, st.ev_emit)
             else:
                 ev_emit = st.ev_emit
+            if causal:
+                # all emit rows share this dispatch's seq + clock (the
+                # dense-branch rule) — plain masked selects
+                ev_parent = jnp.where(take, seq_i32, st.ev_parent)
+                ev_lam = jnp.where(take, lam_new, st.ev_lam)
+            else:
+                ev_parent, ev_lam = st.ev_parent, st.ev_lam
         else:
             if pool_index:
                 # readiness-index free search, O(E/T + T + emits): the
@@ -3082,6 +3252,14 @@ def make_step(
                     st.ev_emit.reshape(n_tiles, p_tile)
                     if timeline_cap else None
                 )
+                pa2 = (
+                    st.ev_parent.reshape(n_tiles, p_tile)
+                    if causal else None
+                )
+                pl2 = (
+                    st.ev_lam.reshape(n_tiles, p_tile)
+                    if causal else None
+                )
                 for j in range(k1):
 
                     def upd(arr2, val, _s=match[j], _t=tj[j]):
@@ -3099,6 +3277,9 @@ def make_step(
                     p2 = upd(p2, emp[j])
                     if timeline_cap:
                         e2 = upd(e2, now)
+                    if causal:
+                        pa2 = upd(pa2, seq_i32)
+                        pl2 = upd(pl2, lam_new)
                 ev_valid = v2.reshape(e_slots)
                 ev_time = t2.reshape(e_slots)
                 ev_meta = m2.reshape(e_slots)
@@ -3108,6 +3289,11 @@ def make_step(
                 ev_emit = (
                     e2.reshape(e_slots) if timeline_cap else st.ev_emit
                 )
+                if causal:
+                    ev_parent = pa2.reshape(e_slots)
+                    ev_lam = pl2.reshape(e_slots)
+                else:
+                    ev_parent, ev_lam = st.ev_parent, st.ev_lam
             else:
                 ev_valid = ev_valid_mid.at[slot].set(e_valid, mode="drop")
                 ev_time = ev_time_mid.at[slot].set(e_time, mode="drop")
@@ -3121,6 +3307,15 @@ def make_step(
                     )
                 else:
                     ev_emit = st.ev_emit
+                if causal:
+                    ev_parent = st.ev_parent.at[slot].set(
+                        jnp.broadcast_to(seq_i32, (k1,)), mode="drop"
+                    )
+                    ev_lam = st.ev_lam.at[slot].set(
+                        jnp.broadcast_to(lam_new, (k1,)), mode="drop"
+                    )
+                else:
+                    ev_parent, ev_lam = st.ev_parent, st.ev_lam
             if pool_index:
                 # index maintenance, part 2: fold the insertions into
                 # their tiles' summaries (<= k1 scatter-min/add rows),
@@ -3425,6 +3620,35 @@ def make_step(
                 | jnp.uint32(4 << 24)
             )
             cov, cov_hits = _tap(cov, cov_hits, f_when, user_dispatch)
+            if causal:
+                # causal depth/width feature (tag 7): log2 bucket of the
+                # folded Lamport clock x log2 bucket of the cross-node
+                # causal JUMP (how far the arriving event's clock was
+                # ahead of the node's own — a big jump is a long
+                # causal chain crossing nodes). A schedule reaching a
+                # new depth or jump class is new behavior the guided
+                # hunt can chase — "deeper causality" as coverage.
+                _pow2 = jnp.asarray(
+                    np.power(2, np.arange(1, 32, dtype=np.uint64)).astype(
+                        np.uint32
+                    )
+                )
+                depth_b = jnp.sum((lam_new >= _pow2).astype(jnp.uint32))
+                # int64 difference, clipped: the uint32 subtraction
+                # would be a wrap surface when the node is AHEAD of the
+                # arriving event (the common same-node case)
+                jump = jnp.clip(
+                    evlam_i.astype(jnp.int64) - lam_prev.astype(jnp.int64),
+                    0,
+                    None,
+                ).astype(jnp.uint32)
+                jump_b = jnp.sum((jump >= _pow2).astype(jnp.uint32))
+                f_causal = (
+                    depth_b
+                    | (jump_b << jnp.uint32(8))
+                    | jnp.uint32(7 << 24)
+                )
+                cov, cov_hits = _tap(cov, cov_hits, f_causal, dispatch)
             # appended history records: (op, key, arg, ok) words — term
             # bumps, elected leaders, committed (index, value) pairs
             for j in range(rr):
@@ -3548,6 +3772,10 @@ def make_step(
                 tl_args = jnp.where(t_sel[:, None], args[None, :], st.tl_args)
                 tl_pay = jnp.where(t_sel[:, None], pay_i[None, :], st.tl_pay)
                 tl_emit = jnp.where(t_sel, emit_i, st.tl_emit)
+                if causal:
+                    tl_seq = jnp.where(t_sel, seq_i32, st.tl_seq)
+                    tl_parent = jnp.where(t_sel, parent_i, st.tl_parent)
+                    tl_lam = jnp.where(t_sel, lam_new, st.tl_lam)
             else:
                 t_slot = jnp.where(t_do, st.tl_count, jnp.int32(timeline_cap))
                 tl_t = st.tl_t.at[t_slot].set(now, mode="drop")
@@ -3555,12 +3783,23 @@ def make_step(
                 tl_args = st.tl_args.at[t_slot].set(args, mode="drop")
                 tl_pay = st.tl_pay.at[t_slot].set(pay_i, mode="drop")
                 tl_emit = st.tl_emit.at[t_slot].set(emit_i, mode="drop")
+                if causal:
+                    tl_seq = st.tl_seq.at[t_slot].set(seq_i32, mode="drop")
+                    tl_parent = st.tl_parent.at[t_slot].set(
+                        parent_i, mode="drop"
+                    )
+                    tl_lam = st.tl_lam.at[t_slot].set(lam_new, mode="drop")
+            if not causal:
+                tl_seq, tl_parent, tl_lam = (
+                    st.tl_seq, st.tl_parent, st.tl_lam
+                )
             tl_count = st.tl_count + t_do.astype(jnp.int32)
             tl_drop = st.tl_drop + (dispatch & ~tfits).astype(jnp.int32)
         else:
             tl_count, tl_drop = st.tl_count, st.tl_drop
             tl_t, tl_meta, tl_args = st.tl_t, st.tl_meta, st.tl_args
             tl_pay, tl_emit = st.tl_pay, st.tl_emit
+            tl_seq, tl_parent, tl_lam = st.tl_seq, st.tl_parent, st.tl_lam
 
         # ---- trace + clock ----
         trace = jnp.where(
@@ -3612,6 +3851,12 @@ def make_step(
             tl_pay=tl_pay,
             ev_emit=ev_emit,
             tl_emit=tl_emit,
+            lam=lam,
+            ev_parent=ev_parent,
+            ev_lam=ev_lam,
+            tl_seq=tl_seq,
+            tl_parent=tl_parent,
+            tl_lam=tl_lam,
             lat_inv=lat_inv,
             lat_resp=lat_resp,
             lat_hist=lat_hist,
@@ -3753,6 +3998,7 @@ def make_run(
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
     cold_split: bool = False,
+    causal: bool = False,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -3780,7 +4026,7 @@ def make_run(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool, _lat_export=cold,
+        pool_index, rank_place_max_pool, causal, _lat_export=cold,
     ))
 
     if cold:
@@ -3821,6 +4067,7 @@ def make_run_while(
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
     cold_split: bool = False,
+    causal: bool = False,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -3841,7 +4088,7 @@ def make_run_while(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool, _lat_export=cold,
+        pool_index, rank_place_max_pool, causal, _lat_export=cold,
     ))
     advance = (
         _cold_split_body(step, _make_cold_lat_apply(latency, wl.lat_markers))

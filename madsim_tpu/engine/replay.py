@@ -59,6 +59,14 @@ class ReplayEvent:
     it (the true send time Perfetto flow arrows anchor at). -1 = not
     captured (oracle replays and pre-emit rings); it never participates
     in the trace fold.
+
+    ``seq``/``parent``/``lam`` are the causal-provenance columns
+    (``causal=True`` captures only; engine/core.py make_step): ``seq``
+    is this dispatch's per-seed sequence number, ``parent`` the seq of
+    the dispatch that emitted this event (or a ``PARENT_*`` sentinel:
+    -1 init, -2 chaos/engine plan, -3 client-army row), ``lam`` the
+    destination node's Lamport clock AFTER the happens-before fold.
+    Defaults mean "not captured"; none participate in the trace fold.
     """
 
     time_ns: int
@@ -68,6 +76,9 @@ class ReplayEvent:
     args: tuple
     pay: tuple
     emit_ns: int = -1
+    seq: int = -1
+    parent: int = -1
+    lam: int = 0
 
     def kind_name(self, wl: Workload | None = None) -> str:
         # extended chaos kinds (>= FIRST_EXT_KIND) are engine kinds too

@@ -86,7 +86,8 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
                     cov_words: int = 0, metrics: bool = False,
                     timeline_cap: int = 0, cov_hitcount: bool = False,
                     latency=None, compact: bool = False,
-                    pool_index: bool | None = None, hist_screen=None):
+                    pool_index: bool | None = None, hist_screen=None,
+                    causal: bool = False):
     # the ONE construction of a batched sweep's (init, run) pair —
     # make_sweep (the device-composable form) and search_seeds' cached
     # runner both build through here, so a flag added to one path cannot
@@ -106,7 +107,7 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
         )
     obs_kw = dict(
         metrics=metrics, timeline_cap=timeline_cap,
-        cov_hitcount=cov_hitcount, latency=latency,
+        cov_hitcount=cov_hitcount, latency=latency, causal=causal,
     )
     init = make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
                      pool_index=pool_index, **obs_kw)
@@ -140,6 +141,7 @@ def make_sweep(
     cov_hitcount: bool = False,
     latency=None,
     pool_index: bool | None = None,
+    causal: bool = False,
 ):
     """Build the traceable batched sweep: ``sweep(seeds[, rows]) -> view``.
 
@@ -156,7 +158,7 @@ def make_sweep(
         wl, cfg, max_steps, layout=layout, plan_slots=plan_slots,
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency, pool_index=pool_index,
+        latency=latency, pool_index=pool_index, causal=causal,
     )
 
     def sweep(seeds, rows=None):
@@ -173,7 +175,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   cov_words: int = 0, metrics: bool = False,
                   timeline_cap: int = 0, cov_hitcount: bool = False,
                   latency=None, pool_index: bool | None = None,
-                  hist_screen=None):
+                  hist_screen=None, causal: bool = False):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width. The env-defaulted
@@ -193,7 +195,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
            latency, pool_index, resolve_rank_place_max_pool(),
-           hist_screen)
+           hist_screen, causal)
     if key not in _RUN_CACHE:
         # imported here: obs is a consumer of the engine — a module-level
         # import would run the whole obs package during engine import
@@ -204,7 +206,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
             dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
             timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
             latency=latency, compact=compact, pool_index=pool_index,
-            hist_screen=hist_screen,
+            hist_screen=hist_screen, causal=causal,
         )
         # make_run_compacted jits internally per growth stage (its
         # build wall stays inside dispatch — documented limitation)
@@ -290,6 +292,12 @@ class SearchReport:
     flagged_idx: np.ndarray | None = None
     flagged_history: object | None = None
     hist_fold: np.ndarray | None = None
+    # causal provenance (causal=True): the final per-node Lamport
+    # clocks, (S, N) uint32 — per-seed causal depth/width stats reduce
+    # with obs.fleet_reduce(lam=...); with timeline_cap the ring's
+    # tl_seq/tl_parent/tl_lam columns ride report.timeline and
+    # obs.causal.causal_slice computes violation cones from them
+    lam: np.ndarray | None = None
 
     @property
     def failing_seeds(self) -> np.ndarray:
@@ -439,6 +447,7 @@ def search_seeds(
     latency=None,
     pool_index: bool | None = None,
     device_check=None,
+    causal: bool = False,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -504,6 +513,13 @@ def search_seeds(
     (make_step docstring; value-identical, auto on for CPU scatter
     pools past the crossover) — it keys the compiled-run cache like
     every other build flag.
+
+    ``causal=True`` folds exact causal provenance (make_step docstring):
+    the final per-node Lamport clocks return as ``report.lam`` (S, N)
+    and — with ``timeline_cap`` — the ring gains the
+    ``tl_seq``/``tl_parent``/``tl_lam`` DAG columns, which
+    ``obs.causal.causal_slice`` turns into the backward happens-before
+    cone of a violation. Derived state only, like every tap here.
 
     ``device_check`` (a ``check.device.HistoryScreen`` or tuple of
     them) is the device-resident form of ``history_invariant``
@@ -604,6 +620,7 @@ def search_seeds(
         # the lockstep path screens via _screen_prog, so its run cache
         # entry must stay shared with unscreened sweeps
         hist_screen=screens if compact else None,
+        causal=causal,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):
@@ -728,6 +745,7 @@ def search_seeds(
             f: np.asarray(view[f])
             for f in ("tl_count", "tl_drop", "tl_t", "tl_meta",
                       "tl_args", "tl_pay", "tl_emit")
+            + (("tl_seq", "tl_parent", "tl_lam") if causal else ())
         })
         tl_dropped = tl.tl_drop > 0
     else:
@@ -765,4 +783,5 @@ def search_seeds(
             np.asarray(view["hist_fold"])
             if screens is not None and compact else None
         ),
+        lam=np.asarray(view["lam"]) if causal else None,
     )

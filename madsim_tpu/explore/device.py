@@ -476,7 +476,7 @@ def _build_programs(
     wl, cfg, space, *, invariant, batch, max_steps, cov_words, layout,
     require_halt, select_top, max_corpus, vcap, max_ops, inherit_seed_p,
     cov_hitcount, metrics, latency, mesh, seed_corpus, cache_key,
-    pool_index=None, history_check=None,
+    pool_index=None, history_check=None, causal=False,
 ):
     """Build one cache entry: the (uniform, breed, refs) triple.
 
@@ -503,7 +503,7 @@ def _build_programs(
         wl, cfg, max_steps, layout=layout, plan_slots=p_slots,
         dup_rows=dup, cov_words=cov_words, metrics=metrics,
         timeline_cap=0, cov_hitcount=cov_hitcount, latency=latency,
-        pool_index=pool_index,
+        pool_index=pool_index, causal=causal,
     )
     k_ov = len(seed_corpus)
     if k_ov:
@@ -787,7 +787,7 @@ class _CampaignSession:
         max_steps, cov_words, layout, require_halt, seed_corpus, select_top,
         max_corpus, max_ops, inherit_seed_p, log, cov_hitcount, telemetry,
         resume, checkpoint_path, latency, metrics, mesh, viol_cap,
-        pool_index, history_check,
+        pool_index, history_check, causal=False,
     ):
         if isinstance(space, FaultPlan):
             space = PlanSpace(space)
@@ -922,6 +922,7 @@ class _CampaignSession:
             int(max_corpus), vcap, max_ops, float(inherit_seed_p),
             bool(cov_hitcount), bool(metrics), latency, _mesh_key(mesh),
             tuple(lp.hash() for lp in seed_corpus), pool_index,
+            bool(causal),
             # invariant identity of the device history screen: screens
             # are value-hashable literals, so equal screen sets share
             # programs across campaigns (the ROADMAP "invariant
@@ -939,6 +940,7 @@ class _CampaignSession:
                 metrics=metrics, latency=latency, mesh=mesh,
                 seed_corpus=seed_corpus, cache_key=key,
                 pool_index=pool_index, history_check=history_check,
+                causal=causal,
             ),
         )
 
@@ -1153,6 +1155,7 @@ def run_device(
     viol_cap: int | None = None,
     pool_index: bool | None = None,
     history_check=None,
+    causal: bool = False,
 ) -> ExploreReport:
     """Run one exploration campaign with every generation device-resident.
 
@@ -1179,6 +1182,12 @@ def run_device(
       device-count rows to the host); ``latency`` likewise folds fleet
       sketches via ``parallel.merge_latency``. Both are derived state:
       campaign outcomes are unchanged.
+    * ``causal=True`` runs the generations with the engine's causal
+      columns on (``explore.run`` docstring): the causal-depth/width
+      coverage feature class joins the guidance, at the cost of the
+      per-seed provenance columns riding the sweep. The flag is a
+      ``_GEN_CACHE`` key component — on/off campaigns never share a
+      compiled program.
     * ``viol_cap`` bounds the device violation store (default
       ``max_corpus``); a campaign that finds more raises instead of
       silently breaking the (seed, trace) dedup.
@@ -1206,7 +1215,7 @@ def run_device(
         telemetry=telemetry, resume=resume,
         checkpoint_path=checkpoint_path, latency=latency, metrics=metrics,
         mesh=mesh, viol_cap=viol_cap, pool_index=pool_index,
-        history_check=history_check,
+        history_check=history_check, causal=causal,
     )
     sess.start("device")
 

@@ -259,6 +259,7 @@ def replay_entry(
     metrics: bool = False,
     timeline_cap: int = 0,
     latency=None,
+    causal: bool = False,
 ) -> SearchReport:
     """Re-execute one corpus entry's exact ``(seed, plan)`` pair.
 
@@ -268,9 +269,11 @@ def replay_entry(
     guarantee tests and the soak assert. ``dup_rows`` defaults to what
     the entry's plan needs (the shrink_plan rule) — pass it explicitly
     only to replay under a differently compiled step on purpose.
-    ``metrics``/``timeline_cap`` turn on the observability taps
-    (madsim_tpu.obs) for the replay — the forensics path: derived state
-    only, so the replayed trace still equals ``entry.trace``.
+    ``metrics``/``timeline_cap``/``causal`` turn on the observability
+    taps (madsim_tpu.obs) for the replay — the forensics path: derived
+    state only, so the replayed trace still equals ``entry.trace``
+    (``causal=True`` + ``timeline_cap`` is how a banked violation
+    becomes an ``obs.causal_slice`` happens-before cone).
     """
     if dup_rows is None:
         dup_rows = bool(entry.plan.uses_dup())
@@ -286,7 +289,7 @@ def replay_entry(
         plan_rows=stack_plan_rows([entry.plan]),
         plan_hash=entry.plan.hash(), dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
-        latency=latency,
+        latency=latency, causal=causal,
     )
 
 
@@ -318,6 +321,7 @@ def run(
     latency=None,
     pool_index: bool | None = None,
     energy=None,
+    causal: bool = False,
 ) -> ExploreReport:
     """Run one coverage-guided exploration campaign.
 
@@ -368,6 +372,15 @@ def run(
     uniform-mode schedule) is bit-identical to the historical behavior
     (test-pinned), which keeps ``select_top``/``inherit_seed_p`` as the
     reproducible defaults.
+
+    ``causal=True`` runs every generation with the engine's causal
+    columns on, which activates the causal-depth/width coverage
+    feature class (make_step feature tag 7): schedules that build
+    DEEPER happens-before chains or larger emit-jumps set fresh
+    coverage bits, so "more intricate causality" steers the hunt the
+    way branch coverage does — and every banked violation replays
+    straight into an ``obs.causal_slice`` cone (``replay_entry`` with
+    ``causal=True, timeline_cap=...``).
     """
     import time as _time
 
@@ -525,7 +538,7 @@ def run(
             history_invariant=history_invariant,
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
             cov_words=cov_words, cov_hitcount=cov_hitcount,
-            latency=latency, pool_index=pool_index,
+            latency=latency, pool_index=pool_index, causal=causal,
         )
         t_after = _time.monotonic()  # lint: allow(wall-clock)
         # the trace/lower/compile share of this dispatch (nonzero only

@@ -87,6 +87,7 @@ def run_pipelined(
     viol_cap: int | None = None,
     pool_index: bool | None = None,
     history_check=None,
+    causal: bool = False,
 ) -> ExploreReport:
     """``explore.run_device`` on a depth-``depth`` pipelined schedule.
 
@@ -115,7 +116,7 @@ def run_pipelined(
         telemetry=telemetry, resume=resume,
         checkpoint_path=checkpoint_path, latency=latency, metrics=metrics,
         mesh=mesh, viol_cap=viol_cap, pool_index=pool_index,
-        history_check=history_check,
+        history_check=history_check, causal=causal,
     )
     sess.log_label = "pipelined"
     sess.start("device-pipelined", pipeline_depth=depth)

@@ -1195,6 +1195,7 @@ def check_ranges(
     timeline_cap: int = 0,
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
+    causal: bool = False,
     horizon_ns: int | None = None,
     n_steps: int = 4,
     n_seeds: int = 2,
@@ -1212,7 +1213,7 @@ def check_ranges(
         layout=layout, time32=time32, placement=placement,
         pool_index=pool_index, dup_rows=dup_rows, cov_words=cov_words,
         metrics=metrics, timeline_cap=timeline_cap,
-        cov_hitcount=cov_hitcount,
+        cov_hitcount=cov_hitcount, causal=causal,
         latency=(
             (latency.ops, latency.phases, latency.phase_ns)
             if latency is not None else None
@@ -1221,12 +1222,12 @@ def check_ranges(
     obs_kw = dict(
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency,
+        latency=latency, causal=causal,
     )
     init = make_init(
         wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency, pool_index=pool_index,
+        latency=latency, pool_index=pool_index, causal=causal,
     )
     state = init(np.zeros(max(n_seeds, 1), np.uint64))
     if entry == "step":
@@ -1408,9 +1409,16 @@ def run_mutant_controls() -> list:
 ABSINT_AXES = {
     "base": {},
     "dup": dict(dup_rows=True),
+    # the causal-provenance counters (ISSUE 19): the Lamport fold
+    # (max + 1 per dispatch) and the int32 dispatch-sequence stamp both
+    # grow with the step count, so their overflow-freedom rests on the
+    # step-budget contract (column_contracts bounds lam and seq by
+    # ABSINT_STEP_MAX) — this row makes the prover actually walk that
+    # arithmetic rather than trusting the bound.
+    "causal": dict(causal=True, timeline_cap=8),
     "all": dict(
         metrics=True, timeline_cap=8, cov_words=8, cov_hitcount=True,
-        latency=LatencySpec(ops=8, phases=2),
+        latency=LatencySpec(ops=8, phases=2), causal=True,
     ),
 }
 

@@ -181,6 +181,7 @@ def check_noninterference(
     cov_hitcount: bool = False,
     latency: LatencySpec | None = None,
     pool_index: bool | None = None,
+    causal: bool = False,
     n_steps: int = 4,
     n_seeds: int = 2,
     mutate=None,
@@ -224,7 +225,7 @@ def check_noninterference(
     flags = dict(
         layout=layout, time32=time32, placement=placement, dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
-        cov_hitcount=cov_hitcount, pool_index=pool_index,
+        cov_hitcount=cov_hitcount, pool_index=pool_index, causal=causal,
         # JSON-able form (reports serialize): the spec's defining triple
         latency=(
             (latency.ops, latency.phases, latency.phase_ns)
@@ -234,7 +235,7 @@ def check_noninterference(
     obs_kw = dict(
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency,
+        latency=latency, causal=causal,
     )
     if check:
         if entry == "step":
@@ -262,7 +263,7 @@ def check_noninterference(
     init = make_init(
         wl, cfg, time32=time32, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
-        latency=latency, pool_index=pool_index,
+        latency=latency, pool_index=pool_index, causal=causal,
     )
     state = init(np.zeros(max(n_seeds, 1), np.uint64))
     if entry == "step":
@@ -437,9 +438,18 @@ BUILD_AXES = {
     "coverage": dict(cov_words=8),
     "hitcount": dict(cov_words=8, cov_hitcount=True),
     "latency": dict(latency=LatencySpec(ops=8, phases=2)),
+    # the causal-provenance columns (ISSUE 19): the per-node Lamport
+    # clock, the pool's parent/lam provenance columns and the ring's
+    # seq/parent/lam banks. The clock FOLDS across dispatches
+    # (lam[dst] = max(lam[dst], lam_at_emit) + 1) — a read-modify-write
+    # cycle entirely inside the derived set, which is exactly the shape
+    # a leak would take if the fold ever touched the RNG cursor or the
+    # pool times, so the row is swept with the timeline on (the ring
+    # banks only exist with a ring to write into).
+    "causal": dict(causal=True, timeline_cap=8),
     "all": dict(
         metrics=True, timeline_cap=8, cov_words=8, cov_hitcount=True,
-        latency=LatencySpec(ops=8, phases=2),
+        latency=LatencySpec(ops=8, phases=2), causal=True,
     ),
 }
 
@@ -489,6 +499,11 @@ CAMPAIGN_AXES = {
     "sharded-campaign": dict(
         cov_words=8, metrics=True, latency=LatencySpec(ops=8, phases=2),
     ),
+    # the causal campaign (ISSUE 19): run_device with causal=True — the
+    # Lamport fold + provenance ring traced THROUGH the shard_map call
+    # boundary, proving the clock columns stay label-free per shard
+    # exactly as they do in the single-chip program.
+    "sharded-causal": dict(cov_words=8, causal=True, timeline_cap=8),
 }
 
 # The flight-recorder boundary entry (PR 12): the campaign tap set

@@ -23,6 +23,15 @@ zero-size arrays and bit-identical values):
   progress for exploration campaigns and soaks, and ``explain``: the
   per-violation narrative interleaving timeline, history ops and the
   checker verdict.
+* **causal provenance** (obs/causal.py) — under the engine's
+  ``causal=True`` axis every ring row carries exact lineage (dispatch
+  seq, emitting-dispatch parent, per-node Lamport clock);
+  ``causal_slice`` computes the backward happens-before **cone** of a
+  violating record (everything outside it is provably concurrent),
+  ``explain(causal=True)`` narrates the cone instead of the whole
+  stream, ``explain_diff(causal=True)`` names the first divergent
+  causal edge, Perfetto arrows become exact, and ``fleet_reduce``
+  folds per-seed depth/width stats on device.
 * **tail latency** (obs/latency.py) — device-side reduction of the
   engine's per-seed log-linear latency sketches (``LatencySpec`` +
   ``chaos.ClientArmy`` open-loop load): per-window p50/p90/p99/p999 +
@@ -70,6 +79,14 @@ from .flight import (  # noqa: F401
     campaign_perfetto,
     write_campaign_perfetto,
 )
+from .causal import (  # noqa: F401
+    CausalCone,
+    causal_slice,
+    derive_parents,
+    format_cone,
+    parent_class,
+    rederive,
+)
 from .metrics import FleetMetrics, fleet_metrics, fleet_reduce  # noqa: F401
 from .perfetto import to_perfetto, write_perfetto  # noqa: F401
 from .prof import (  # noqa: F401
@@ -86,6 +103,7 @@ from .timeline import (  # noqa: F401
 
 __all__ = [
     "AotProgram",
+    "CausalCone",
     "FleetLatency",
     "FleetMetrics",
     "FlightRecorder",
@@ -97,7 +115,12 @@ __all__ = [
     "N_METRICS",
     "ProgramProfiler",
     "campaign_perfetto",
+    "causal_slice",
     "decode_timeline",
+    "derive_parents",
+    "format_cone",
+    "parent_class",
+    "rederive",
     "device_memory",
     "explain",
     "explain_diff",

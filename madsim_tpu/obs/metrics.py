@@ -67,6 +67,16 @@ class FleetMetrics:
     # 0 when the reducer was handed a bare met batch with no overflow
     # column (fleet_metrics always supplies one).
     overflowed: int = 0
+    # causal-provenance stats (``fleet_reduce(lam=...)``, causal=True
+    # runs): per-seed max Lamport depth — the longest happens-before
+    # chain any node folded — reduced to min/max + log2 histogram, and
+    # the fleet-mean concurrency width sum(lam)/max(lam) (~how many
+    # causal chains advanced in parallel; 1.0 = fully sequential,
+    # n_nodes = perfectly concurrent). None without causal columns.
+    depth_min: int | None = None
+    depth_max: int | None = None
+    depth_hist: np.ndarray | None = None  # (N_BUCKETS,) int64
+    width_mean: float | None = None
 
     @property
     def names(self) -> tuple:
@@ -107,6 +117,20 @@ class FleetMetrics:
             if self.halt_codes[c]
         )
         lines.append(f"  halt codes: {halt or 'none'}")
+        if self.depth_hist is not None:
+            lines.append(
+                f"  causal: depth min {self.depth_min} max "
+                f"{self.depth_max}, mean concurrency width "
+                f"{self.width_mean:.2f}"
+            )
+            if histograms:
+                nz = np.nonzero(self.depth_hist)[0]
+                if nz.size:
+                    buckets = ", ".join(
+                        f"{_bucket_label(b)}: {int(self.depth_hist[b])}"
+                        for b in nz
+                    )
+                    lines.append(f"      depth hist {buckets}")
         if self.overflowed:
             lines.append(
                 f"  WARNING: {self.overflowed} seed(s) overflowed the "
@@ -151,7 +175,32 @@ def _reduce(met):
     return totals, mins, maxs, hist, halt
 
 
-def fleet_reduce(met, overflow=None) -> FleetMetrics:
+@jax.jit
+def _reduce_lam(lam):
+    """(S, N) uint32 Lamport clocks -> fleet causal stats, on device.
+
+    Per-seed depth = max over nodes (the longest happens-before chain
+    folded anywhere); per-seed width = sum/max (total causal work over
+    the critical path — the classic parallelism ratio). Only the
+    scalar/histogram reductions leave the device.
+    """
+    depth = jnp.max(lam, axis=1).astype(jnp.int64)  # (S,)
+    total = jnp.sum(lam.astype(jnp.int64), axis=1)
+    width = jnp.where(depth > 0, total / jnp.maximum(depth, 1), 1.0)
+    thresholds = jnp.asarray(
+        [1 << b for b in range(N_BUCKETS - 1)], jnp.int64
+    )
+    bucket = jnp.sum(depth[:, None] >= thresholds[None, :], axis=-1)
+    hist = jnp.sum(
+        (bucket[:, None] == jnp.arange(N_BUCKETS)[None, :]).astype(
+            jnp.int64
+        ),
+        axis=0,
+    )
+    return jnp.min(depth), jnp.max(depth), hist, jnp.mean(width)
+
+
+def fleet_reduce(met, overflow=None, lam=None) -> FleetMetrics:
     """Reduce an (S, N_METRICS) per-seed metric batch to fleet shape.
 
     ``met`` may be the device-resident ``SimState.met`` batch (the
@@ -160,6 +209,9 @@ def fleet_reduce(met, overflow=None) -> FleetMetrics:
     same values either way. Pass the run's ``overflow`` column too when
     available: overflowed seeds' counters undercount (dropped events
     never dispatched), and the reduction surfaces their count loudly.
+    ``lam`` is a causal run's (S, N) Lamport-clock batch
+    (``SimState.lam`` / ``SearchReport.lam``): the causal depth/width
+    stats fold on device the same way.
     """
     mm = jnp.asarray(met)
     if mm.ndim != 2 or mm.shape[1] != N_METRICS:
@@ -171,6 +223,15 @@ def fleet_reduce(met, overflow=None) -> FleetMetrics:
     n_over = 0
     if overflow is not None:
         n_over = int(jax.jit(lambda o: jnp.sum(o > 0))(jnp.asarray(overflow)))
+    causal: dict = {}
+    if lam is not None and np.prod(np.shape(lam)):
+        dmin, dmax, dhist, wmean = _reduce_lam(jnp.asarray(lam))
+        causal = dict(
+            depth_min=int(dmin),
+            depth_max=int(dmax),
+            depth_hist=np.asarray(dhist),
+            width_mean=float(wmean),
+        )
     return FleetMetrics(
         n_seeds=int(mm.shape[0]),
         totals=np.asarray(totals),
@@ -179,6 +240,7 @@ def fleet_reduce(met, overflow=None) -> FleetMetrics:
         hist=np.asarray(hist),
         halt_codes=np.asarray(halt),
         overflowed=n_over,
+        **causal,
     )
 
 

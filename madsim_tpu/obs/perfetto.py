@@ -7,12 +7,17 @@ of ``engine.replay.ReplayEvent``) into the Trace Event JSON format that
 * one **process track per node** — every dispatched event at that node
   is a slice, named by the workload's handler table;
 * **message flow arrows** — each delivered message draws a flow from
-  the sending node's track to the delivery slice. Rings captured with
-  the emit-time sidecar (``ReplayEvent.emit_ns``, engine ``ev_emit``/
-  ``tl_emit``) anchor the arrow at the TRUE send time — the dispatch
-  that emitted the message. Older captures (``emit_ns < 0``) fall back
-  to the historical approximation: the sender's last dispatch
-  at-or-before the delivery;
+  the sending node's track to the delivery slice. Causal captures
+  (``ReplayEvent.parent``, engine ``ev_parent``/``tl_parent`` under
+  ``causal=True``) attribute the arrow EXACTLY: it leaves the dispatch
+  that emitted the message, by sequence number — no approximation at
+  all. Rings captured with only the emit-time sidecar
+  (``ReplayEvent.emit_ns``, engine ``ev_emit``/``tl_emit``) anchor the
+  arrow at the true send time but attribute by node; older captures
+  (``emit_ns < 0`` too) fall back to the historical approximation:
+  the sender's last dispatch at-or-before the delivery — which two
+  same-timestamp sends can mis-attribute (the tested reason the
+  causal path exists);
 * **chaos spans** — kill/restart, pause/resume, clog/unclog (node,
   link, and one-way forms), slow/unslow, dup on/off, and disk-fault
   (lying-fsync / torn-write) window pairs from the dispatched stream
@@ -155,6 +160,10 @@ def to_perfetto(
     end_ns = events[-1].time_ns if events else 0
 
     # dispatch slices: one per timeline event — the count invariant
+    # seq -> ring index for exact parent attribution (causal captures)
+    by_seq = {
+        e.seq: i for i, e in enumerate(events) if getattr(e, "seq", -1) >= 0
+    }
     last_idx_at_node: dict = {}
     flow_id = 0
     for i, e in enumerate(events):
@@ -177,13 +186,37 @@ def to_perfetto(
                 "ev_args": list(e.args),
             },
         }
+        if getattr(e, "seq", -1) >= 0:
+            row["args"].update(seq=e.seq, parent=e.parent, lam=e.lam)
         out.append(row)
-        # message flow arrow: anchored at the TRUE send time when the
-        # ring captured the emit-time sidecar (emit_ns >= 0); else the
-        # sender's last dispatch at-or-before this delivery (see the
-        # module docstring)
+        # message flow arrow, best provenance first: exact emitting
+        # dispatch (causal parent seq) > true send time (emit sidecar)
+        # > the sender's last dispatch at-or-before this delivery (see
+        # the module docstring)
         emit_ns = getattr(e, "emit_ns", -1)
-        if e.src >= 0 and emit_ns >= 0:
+        parent_i = (
+            by_seq.get(e.parent)
+            if getattr(e, "parent", -1) >= 0 else None
+        )
+        if e.src >= 0 and parent_i is not None:
+            p = events[parent_i]
+            out.append({
+                "ph": "s", "cat": "flow", "id": flow_id,
+                "name": f"msg n{e.src}->n{e.node}",
+                "pid": p.node, "tid": 0,
+                # the emitting dispatch's own timestamp IS the send
+                # time (emission happens during its handler), so the
+                # exact arrow needs no sidecar — but keep the finer
+                # emit_ns anchor when both were captured
+                "ts": _us(emit_ns if emit_ns >= 0 else p.time_ns),
+            })
+            out.append({
+                "ph": "f", "cat": "flow", "id": flow_id, "bp": "e",
+                "name": f"msg n{e.src}->n{e.node}",
+                "pid": pid, "tid": 0, "ts": _us(e.time_ns),
+            })
+            flow_id += 1
+        elif e.src >= 0 and emit_ns >= 0:
             out.append({
                 "ph": "s", "cat": "flow", "id": flow_id,
                 "name": f"msg n{e.src}->n{e.node}",

@@ -114,7 +114,7 @@ _CAPTURE_CACHE_MAX = 8
 
 
 def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout,
-             latency=None):
+             latency=None, causal=False):
     """Re-run one (seed, plan) with the forensics taps on: a field-name
     view dict of the final state plus the literalized plan (or None)."""
     seeds = np.asarray([seed], np.uint64)
@@ -123,18 +123,19 @@ def _capture(wl, cfg, seed, plan, max_steps, timeline_cap, layout,
     else:
         rows, slots, dup, lit = None, 0, False, None
     key = (id(wl), cfg.hash(), max_steps, timeline_cap, layout, slots, dup,
-           latency)
+           latency, causal)
     if key not in _CAPTURE_CACHE:
         while len(_CAPTURE_CACHE) >= _CAPTURE_CACHE_MAX:
             _CAPTURE_CACHE.pop(next(iter(_CAPTURE_CACHE)))
         _CAPTURE_CACHE[key] = (
             make_init(
                 wl, cfg, plan_slots=slots, metrics=True,
-                timeline_cap=timeline_cap, latency=latency,
+                timeline_cap=timeline_cap, latency=latency, causal=causal,
             ),
             jax.jit(make_run_while(
                 wl, cfg, max_steps, layout=layout, dup_rows=dup,
                 metrics=True, timeline_cap=timeline_cap, latency=latency,
+                causal=causal,
             )),
             wl,  # keep the workload alive so id() stays unique
         )
@@ -160,6 +161,7 @@ def explain(
     layout: str | None = None,
     max_events: int = 200,
     latency=None,
+    causal: bool = False,
 ) -> str:
     """Narrate one ``(seed, plan)`` run: timeline + history + verdict.
 
@@ -174,9 +176,17 @@ def explain(
     tail-latency tap on and adds the latency section: per-window
     percentiles off the seed's own sketch plus the slowest completed
     ops — the narrative an SLO breach needs.
+    ``causal=True`` re-runs with the provenance columns on and narrates
+    the backward happens-before **cone** of the violation instead of
+    the whole stream (``obs.causal.causal_slice`` anchored at the last
+    failed history record, else the last record, else the final
+    dispatch): only the events that can have influenced the anchor,
+    each with its seq/Lamport-clock/parent lineage, plus the injected
+    fault windows inside the cone.
     """
     view, lit = _capture(
-        wl, cfg, seed, plan, max_steps, timeline_cap, layout, latency
+        wl, cfg, seed, plan, max_steps, timeline_cap, layout, latency,
+        causal,
     )
 
     lines = [
@@ -202,43 +212,48 @@ def explain(
         )
         for i in range(hist_n)
     ]
-    merged = []
-    hi = 0
-    for e in events:
-        merged.append(("ev", e))
-        while hi < len(hist) and hist[hi][0] <= e.time_ns:
-            merged.append(("rec", hist[hi]))
-            hi += 1
-    merged.extend(("rec", h) for h in hist[hi:])
+    if causal:
+        # the cone narration replaces the whole-stream section: only
+        # the events that happens-before-precede the violation anchor
+        lines.extend(_cone_section(events, hist, view, wl, max_events))
+    else:
+        merged = []
+        hi = 0
+        for e in events:
+            merged.append(("ev", e))
+            while hi < len(hist) and hist[hi][0] <= e.time_ns:
+                merged.append(("rec", hist[hi]))
+                hi += 1
+        merged.extend(("rec", h) for h in hist[hi:])
 
-    lines.append(
-        f"--- timeline ({len(events)} dispatched events, "
-        f"{hist_n} history records"
-        + (f", {int(view['tl_drop'][0])} DROPPED at ring capacity"
-           if int(view["tl_drop"][0]) else "")
-        + "):"
-    )
-    shown = merged
-    if len(merged) > max_events:
-        head = max_events // 3
-        tail = max_events - head
-        shown = (
-            merged[:head]
-            + [("gap", len(merged) - max_events)]
-            + merged[-tail:]
+        lines.append(
+            f"--- timeline ({len(events)} dispatched events, "
+            f"{hist_n} history records"
+            + (f", {int(view['tl_drop'][0])} DROPPED at ring capacity"
+               if int(view["tl_drop"][0]) else "")
+            + "):"
         )
-    for tag, item in shown:
-        if tag == "gap":
-            lines.append(f"    ... {item} rows elided ...")
-        elif tag == "ev":
-            lines.append(f"  {_fmt_event(item, wl)}")
-        else:
-            t, (op, key, arg, client, ok) = item
-            lines.append(
-                f"  [{t / 1e6:>10.3f}ms]   * history: op{op} key={key} "
-                f"arg={arg} client=n{client} "
-                f"{_OK_STORY.get(ok, f'ok={ok}')}"
+        shown = merged
+        if len(merged) > max_events:
+            head = max_events // 3
+            tail = max_events - head
+            shown = (
+                merged[:head]
+                + [("gap", len(merged) - max_events)]
+                + merged[-tail:]
             )
+        for tag, item in shown:
+            if tag == "gap":
+                lines.append(f"    ... {item} rows elided ...")
+            elif tag == "ev":
+                lines.append(f"  {_fmt_event(item, wl)}")
+            else:
+                t, (op, key, arg, client, ok) = item
+                lines.append(
+                    f"  [{t / 1e6:>10.3f}ms]   * history: op{op} key={key} "
+                    f"arg={arg} client=n{client} "
+                    f"{_OK_STORY.get(ok, f'ok={ok}')}"
+                )
 
     met = view["met"][0]
     code = int(met[MET_HALT_CODE])
@@ -327,6 +342,37 @@ def _latency_section(view, latency) -> list:
     return lines
 
 
+def _cone_section(events, hist, view, wl, max_events) -> list:
+    """The ``explain(causal=True)`` timeline section: anchor selection
+    plus the happens-before cone narration (obs/causal.py)."""
+    from .causal import causal_slice, format_cone
+
+    failed = [h for h in hist if h[1][4] == 0]
+    if failed:
+        t, (op, key, arg, client, _ok) = failed[-1]
+        anchor, what = (t, client), (
+            f"last FAILED history record (op{op} key={key} client=n{client} "
+            f"at {t / 1e6:.3f}ms)"
+        )
+    elif hist:
+        t, (op, key, arg, client, _ok) = hist[-1]
+        anchor, what = (t, client), (
+            f"last history record (op{op} client=n{client} "
+            f"at {t / 1e6:.3f}ms)"
+        )
+    else:
+        anchor, what = None, "final dispatch (no history records)"
+    lines = [f"--- causal anchor: {what}"]
+    if int(view["tl_drop"][0]):
+        lines.append(
+            f"    WARNING: {int(view['tl_drop'][0])} event(s) dropped at "
+            f"ring capacity — the cone's ancestry is prefix-only"
+        )
+    cone = causal_slice(events, seed=0, anchor=anchor)
+    lines.append(format_cone(cone, wl, max_events=max_events))
+    return lines
+
+
 def _fmt_event(e, wl) -> str:
     origin = "timer" if e.src < 0 else f"node{e.src}"
     argstr = ",".join(str(a) for a in e.args)
@@ -340,6 +386,42 @@ def _row_key(e) -> tuple:
     return (e.time_ns, e.kind, e.node, e.src, tuple(e.args), tuple(e.pay))
 
 
+def _edge_divergence(ev_a, ev_b, wl) -> list:
+    """Name the first causal edge the two runs attribute differently.
+
+    Over the common prefix the per-seed dispatch seqs coincide row for
+    row, so comparing raw ``parent`` values IS comparing edges in the
+    two derivation DAGs — the first mismatch is the fork, and it can
+    sit at a row whose (time, kind, node, args) tuple is still
+    identical on both sides (same event, different emitter)."""
+    from .causal import derive_parents, parent_class
+
+    pa, pb = derive_parents(ev_a), derive_parents(ev_b)
+
+    def _edge(evs, parents, i):
+        e = evs[i]
+        if e.parent < 0:
+            return f"seq {e.seq} <- {parent_class(e.parent)} row"
+        j = parents[i]
+        via = (
+            _fmt_event(evs[j], wl) if j is not None
+            else "(emitter outside the captured ring)"
+        )
+        return f"seq {e.seq} <- seq {e.parent}  {via}"
+
+    for i in range(min(len(ev_a), len(ev_b))):
+        if ev_a[i].parent != ev_b[i].parent:
+            return [
+                f"--- first divergent causal edge: row {i}",
+                f"    clean:     {_edge(ev_a, pa, i)}",
+                f"    violating: {_edge(ev_b, pb, i)}",
+            ]
+    return [
+        "--- causal edges identical over the common "
+        f"{min(len(ev_a), len(ev_b))}-row prefix"
+    ]
+
+
 def explain_diff(
     wl,
     cfg,
@@ -351,6 +433,7 @@ def explain_diff(
     timeline_cap: int = 1024,
     layout: str | None = None,
     context: int = 6,
+    causal: bool = False,
 ) -> str:
     """Localize where a violating run departs from a clean sibling.
 
@@ -365,13 +448,22 @@ def explain_diff(
     statement, not a heuristic), a window of common context before it,
     and each side's continuation plus verdict. Identical streams are
     reported as such — then the divergence is in final state only.
+
+    ``causal=True`` captures both runs with the provenance columns on
+    and names the first divergent causal **edge** as well: the first
+    row whose parent attribution differs between the runs — which can
+    precede the first divergent row tuple (two schedules can dispatch
+    the same (time, kind, node, args) event from *different* emitting
+    dispatches), and is the actual fork in the derivation DAG.
     """
     (seed_a, plan_a), (seed_b, plan_b) = clean, violating
     view_a, lit_a = _capture(
-        wl, cfg, seed_a, plan_a, max_steps, timeline_cap, layout
+        wl, cfg, seed_a, plan_a, max_steps, timeline_cap, layout,
+        causal=causal,
     )
     view_b, lit_b = _capture(
-        wl, cfg, seed_b, plan_b, max_steps, timeline_cap, layout
+        wl, cfg, seed_b, plan_b, max_steps, timeline_cap, layout,
+        causal=causal,
     )
     ev_a = decode_timeline(view_a, wl, 0)
     ev_b = decode_timeline(view_b, wl, 0)
@@ -430,6 +522,9 @@ def explain_diff(
                 lines.append("        (stream ends)")
             for i in range(div, min(div + context, len(evs))):
                 lines.append(f"    {tag[0]}{i:>5}  {_fmt_event(evs[i], wl)}")
+
+    if causal:
+        lines.extend(_edge_divergence(ev_a, ev_b, wl))
 
     for tag, side in (("clean", view_a), ("violating", view_b)):
         met = side["met"][0]

@@ -69,6 +69,17 @@ def decode_timeline(view, wl: Workload | None = None, seed: int = 0) -> list:
             emit = None
     except (KeyError, AttributeError):
         emit = None
+    # causal-provenance columns (causal=True rings): same fallback rule
+    # — pre-causal captures decode with the "not captured" defaults, so
+    # every consumer (Perfetto arrows, obs.causal) must handle seq=-1
+    try:
+        seq = np.asarray(_get(view, "tl_seq"))[seed]
+        parent = np.asarray(_get(view, "tl_parent"))[seed]
+        lam = np.asarray(_get(view, "tl_lam"))[seed]
+        if seq.shape[0] == 0:
+            seq = parent = lam = None
+    except (KeyError, AttributeError):
+        seq = parent = lam = None
     events = []
     for i in range(count):
         m = int(meta[i])
@@ -81,6 +92,9 @@ def decode_timeline(view, wl: Workload | None = None, seed: int = 0) -> list:
                 args=tuple(int(x) for x in args[i]),
                 pay=tuple(int(x) for x in pay[i]),
                 emit_ns=int(emit[i]) if emit is not None else -1,
+                seq=int(seq[i]) if seq is not None else -1,
+                parent=int(parent[i]) if parent is not None else -1,
+                lam=int(lam[i]) if lam is not None else 0,
             )
         )
     return events

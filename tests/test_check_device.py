@@ -257,6 +257,14 @@ def _identity_case(wl, n_seeds, **kw):
 
 
 class TestEngineIdentity:
+    # tier-1 budget (ROADMAP note): the host/device/compact lockstep at
+    # 512 seeds x 2 bug modes is the heaviest compile in this file and
+    # its verdict-identity claim stays tier-1-pinned by
+    # test_fuzz_device_equals_numpy_all_detectors (all detectors),
+    # test_flagged_history_is_the_escalation_input (mutant caught +
+    # exact confirmation) and TestPrefixCompaction (compact verdicts);
+    # the full-scale lockstep is VERIFY_r09 cert 1.
+    @pytest.mark.slow
     def test_kvchaos_clean_and_mutant_lockstep_and_compact(self):
         for bug in (False, True):
             wl = make_kvchaos(writes=5, record=True, bug=bug)
@@ -442,6 +450,11 @@ class TestPrefixCompaction:
 
 # ------------------------------------------- the device history hunt
 class TestDeviceHistoryHunt:
+    # tier-1 budget: the host-vs-device campaign bit-identity + replay
+    # is VERIFY_r09's headline certificate (and the services soak
+    # re-proves it on two more models); tier-1 keeps the API guard
+    # below and the device-detector identity pins above.
+    @pytest.mark.slow
     def test_run_device_history_hunt_matches_host_and_replays(self):
         from madsim_tpu import explore
         from madsim_tpu.chaos import CrashStorm, FaultPlan
@@ -498,6 +511,10 @@ class TestDeviceHistoryHunt:
 
 # ------------------------------------------------ cov_features hook
 class TestCovFeatures:
+    # tier-1 budget: bitmap-growth-without-trace-change is re-pinned
+    # cheaply by test_obs's hit-count rows and the lint
+    # noninterference coverage axes; the spread deltas are EXPLORE_r08.
+    @pytest.mark.slow
     def test_commit_spread_changes_bitmaps_not_traces(self):
         inv = lambda view: np.ones(  # noqa: E731
             np.asarray(view["halted"]).shape[0], bool

@@ -818,6 +818,11 @@ def test_config_fuzz_layouts_agree():
         check_layouts(wl, cfg, np.arange(6, dtype=np.uint64), 120)
 
 
+# tier-1 budget (ROADMAP note): 4,096 seeds x 400 steps is this file's
+# second-heaviest program; the snapshot model's engine values are
+# oracle-pinned tier-1 (test_oracle: snapshot traces bit-identical) and
+# the conservation sweep rides test-full / the soaks.
+@pytest.mark.slow
 def test_snapshot_conservation_under_reordering():
     """Lai-Yang snapshot invariant across 4,096 seeded schedules: the
     recorded cut (balances + channel state) sums EXACTLY to the minted

@@ -227,6 +227,11 @@ class TestIndexEdgeCases:
 
 
 class TestColdSplit:
+    # tier-1 budget: three full army runs through the heaviest model;
+    # the cold-split identity also holds under the stepident matrix
+    # (slow) and the profile sweep, and tier-1 keeps the validation
+    # guard plus the indexed-vs-flat identity pins above.
+    @pytest.mark.slow
     def test_cold_split_bit_identical(self):
         wl = make_raftlog(record=True, army=True)
         cfg = EngineConfig(pool_size=96, loss_p=0.02,

@@ -28,15 +28,17 @@ SHELL   := /bin/bash
         store-soak latency-soak lint lint-soak absint-soak profile clean \
         campaign-bench flight pool-bench pool-bench-smoke \
         verify-bench verify-bench-smoke farm farm-smoke \
-        services-models services-models-smoke causal causal-smoke
+        services-models services-models-smoke causal causal-smoke \
+        retry-soak retry-soak-smoke
 
 check: native lint test determinism bench-smoke flight pool-bench-smoke \
-       verify-bench-smoke farm-smoke services-models-smoke causal-smoke
+       verify-bench-smoke farm-smoke services-models-smoke causal-smoke \
+       retry-soak-smoke
 	@echo "== make check: all gates passed =="
 
 check-full: native lint test-full determinism bench-smoke flight \
             pool-bench-smoke verify-bench-smoke farm-smoke \
-            services-models-smoke causal-smoke
+            services-models-smoke causal-smoke retry-soak-smoke
 	@echo "== make check-full: all gates passed =="
 
 # Static determinism analysis (madsim_tpu.lint): the repo-wide
@@ -163,6 +165,22 @@ causal:
 
 causal-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/causal_soak.py --smoke
+
+# Client-retry soak (chaos.RetryPolicy + the engine retry= axis, ISSUE
+# 20): clean kvchaos/shardkv armies under an aggressive policy + gray
+# failure bank thousands of re-sent attempts with zero violations, the
+# slow link amplifies delivered re-sends >= 2x over the quiet baseline,
+# and the shardkv bug="noidem" mutant (non-idempotent retried apply) is
+# found by the exactly_once-guided hunt, missed by the final-state
+# shard_coverage checker on the same seeds, ddmin-shrunk under the
+# campaign's RetrySpec and replayed bit-identically. The RETRY_r14.txt
+# evidence artifact; the smoke (tiny sizes) rides `make check`.
+retry-soak:
+	$(PY) tools/retry_soak.py > RETRY_r14.txt; rc=$$?; \
+	    cat RETRY_r14.txt; exit $$rc
+
+retry-soak-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/retry_soak.py --smoke
 
 native:
 	$(MAKE) -C native

@@ -41,6 +41,7 @@ from .plan import (  # noqa: F401
     LiteralPlan,
     Partition,
     PauseStorm,
+    RetryPolicy,
     SlotTemplate,
     kind_name,
     stack_plan_rows,
@@ -62,6 +63,7 @@ __all__ = [
     "Nemesis",
     "Partition",
     "PauseStorm",
+    "RetryPolicy",
     "ShrinkResult",
     "SlotTemplate",
     "kind_name",

@@ -124,8 +124,10 @@ class Nemesis:
             raise ValueError(
                 f"nemesis cannot apply user kind {ev.kind}: client-army "
                 f"ops (chaos.ClientArmy) are a batched-engine load "
-                f"surface; on the asyncio runtime drive load with real "
-                f"client tasks instead"
+                f"surface — and any chaos.RetryPolicy attached to one is "
+                f"a batched-engine timer (engine.RetrySpec), not an "
+                f"injectable event; on the asyncio runtime drive load "
+                f"(and retries) with real client tasks instead"
             )
         netsim = handle.simulator(NetSim)
         # dup toggles carry no node; disk-fault kinds resolve their own

@@ -57,6 +57,7 @@ from ..engine.core import (
     KIND_UNCLOG_1W,
     KIND_UNSLOW,
     PlanRows,
+    RetrySpec,
     SLOW_MULT_MAX,
     pack_slow_arg,
     unpack_slow_arg,
@@ -76,6 +77,7 @@ __all__ = [
     "LiteralPlan",
     "SlotTemplate",
     "ClientArmy",
+    "RetryPolicy",
     "CrashStorm",
     "PauseStorm",
     "Partition",
@@ -804,6 +806,44 @@ class DiskFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """A client-side timeout/backoff retry policy for a :class:`ClientArmy`.
+
+    The reference leaves retries to user tokio code; here they are a
+    MODELED, seed-pure policy the engine itself executes: each delivered
+    op arms a response-deadline timer in the pool, and on expiry the op
+    is re-offered with an incremented attempt id (packed into the op
+    token) unless a response was recorded meanwhile. ``max_attempts``
+    counts total deliveries; backoff before attempt ``a >= 1`` is
+    ``backoff_base_ns * backoff_mult**(a-1)``, jittered by a fresh
+    ``PURPOSE_RETRY`` threefry draw scaled to ``[0, jitter]`` of the
+    backoff — every re-send time is a pure function of the seed, so a
+    retry-amplified trajectory replays exactly like any other.
+
+    Attach with ``ClientArmy(..., retry=RetryPolicy(timeout_ns=...))``
+    (the model helpers forward a ``retry=`` keyword), then build the
+    engine with ``retry=plan.retry_spec(wl)``.
+    """
+
+    timeout_ns: int
+    max_attempts: int = 3
+    backoff_base_ns: int = 0
+    backoff_mult: float = 2.0
+    jitter: float = 0.0
+
+    def __post_init__(self):
+        # the full validation lives on the compiled engine spec; run it
+        # here too so a bad policy fails at PLAN build time, with the
+        # army-independent fields stubbed to valid values
+        RetrySpec(
+            kind=FIRST_USER_KIND, node=0, op_base=0, n_ops=1,
+            timeout_ns=self.timeout_ns, max_attempts=self.max_attempts,
+            backoff_base_ns=self.backoff_base_ns,
+            backoff_mult=self.backoff_mult, jitter=self.jitter,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class ClientArmy:
     """Open-loop client load: ``n_ops`` user-kind pool rows delivered to
     ``node`` at threefry-drawn arrival times (madsim_tpu.obs latency).
@@ -839,6 +879,11 @@ class ClientArmy:
     t_max_ns: int = 400_000_000
     arg_hi: int = 0  # args[1] drawn uniform in [0, arg_hi); 0 = constant 0
     op_base: int = 0  # first op id (several armies share the lat columns)
+    # timeout/backoff retry policy (None = the historical fire-and-
+    # forget army: every compiled row is bit-identical either way —
+    # attempt-0 tokens ARE plain op ids, the policy only changes the
+    # engine build through retry_spec())
+    retry: "RetryPolicy | None" = None
 
     def __post_init__(self):
         if self.node < 0:
@@ -855,7 +900,32 @@ class ClientArmy:
             raise ValueError(f"arg_hi must be >= 0, got {self.arg_hi}")
         if self.op_base < 0:
             raise ValueError(f"op_base must be >= 0, got {self.op_base}")
+        if self.retry is not None:
+            if not isinstance(self.retry, RetryPolicy):
+                raise TypeError(
+                    f"ClientArmy.retry must be a RetryPolicy or None, "
+                    f"got {type(self.retry).__name__}"
+                )
+            # build the engine spec once for its validations (op-range
+            # vs token packing, attempt-bit bounds): fail at plan time
+            self.retry_spec()
         _check_window(self.t_min_ns, self.t_max_ns, "arrival")
+
+    def retry_spec(self) -> "RetrySpec":
+        """The compiled engine-side spec of this army's retry policy
+        (``engine.make_step(retry=...)``). Raises when no policy is
+        attached — callers use :meth:`FaultPlan.retry_spec` which maps
+        None-policy plans to None."""
+        if self.retry is None:
+            raise ValueError("this ClientArmy has no RetryPolicy attached")
+        r = self.retry
+        return RetrySpec(
+            kind=self.kind, node=self.node, op_base=self.op_base,
+            n_ops=self.n_ops, timeout_ns=r.timeout_ns,
+            max_attempts=r.max_attempts,
+            backoff_base_ns=r.backoff_base_ns,
+            backoff_mult=r.backoff_mult, jitter=r.jitter,
+        )
 
     @property
     def targets(self) -> tuple:
@@ -994,6 +1064,26 @@ class FaultPlan(_PlanBase):
 
     def uses_dup(self) -> bool:
         return any(isinstance(s, Duplicate) for s in self.specs)
+
+    def retry_spec(self) -> "RetrySpec | None":
+        """The engine retry build parameter this plan implies: the
+        attached ClientArmy's compiled :class:`RetrySpec`, or None when
+        no army carries a policy (the historical build). The engine's
+        retry mechanism tracks ONE op range, so two policied armies in
+        one plan are refused — split the load across plans instead."""
+        specs = [
+            s for s in self.specs
+            if isinstance(s, ClientArmy) and s.retry is not None
+        ]
+        if not specs:
+            return None
+        if len(specs) > 1:
+            raise ValueError(
+                f"plan {self.name!r} attaches RetryPolicy to "
+                f"{len(specs)} client armies; the engine tracks one "
+                f"retried op range per build"
+            )
+        return specs[0].retry_spec()
 
     def hash(self) -> str:
         """Stable hex id of the plan (EngineConfig.hash analog): the

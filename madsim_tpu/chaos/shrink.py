@@ -91,6 +91,7 @@ def shrink_plan(
     layout: str | None = None,
     require_halt: bool = False,
     latency=None,
+    retry=None,
 ) -> ShrinkResult:
     """ddmin a failing ``(seed, plan)`` to a minimal fault-event subset.
 
@@ -110,6 +111,12 @@ def shrink_plan(
     latency violation needs the sketch it judges. Plans holding
     ``ClientArmy`` slots shrink like any other — ddmin drops the client
     ops a breach does not need right alongside the faults.
+
+    ``retry`` (an ``engine.RetrySpec``) arms the client-retry timers in
+    the shrink runs — when None it defaults to the plan's own
+    ``retry_spec()`` if it carries a policied army, so a retry-amplified
+    violation shrinks under the same policy that found it (exact replay
+    includes the re-sent attempts).
 
     Raises ValueError if the full plan does not fail on ``seed`` (a
     shrink needs a failing input).
@@ -144,9 +151,12 @@ def shrink_plan(
                 f"config); shrink the plan windows or disable time32"
             )
     dup = plan.uses_dup()
-    init = make_init(wl, cfg, plan_slots=p, latency=latency)
+    if retry is None and hasattr(plan, "retry_spec"):
+        retry = plan.retry_spec()
+    init = make_init(wl, cfg, plan_slots=p, latency=latency, retry=retry)
     run = jax.jit(make_run_while(
         wl, cfg, max_steps, layout=layout, dup_rows=dup, latency=latency,
+        retry=retry,
     ))
     seeds_b = np.full((b,), seed, np.uint64)
     tested = 0

@@ -65,7 +65,9 @@ from .linearize import LinResult, check_kv, check_register  # noqa: F401
 from .recorder import Recorder  # noqa: F401
 from .slo import slo_bounded, slo_breaches  # noqa: F401
 from .vectorized import (  # noqa: F401
+    collapse_retries,
     election_safety,
+    exactly_once,
     lease_safety,
     monotonic_reads,
     monotonic_reads_strict,
@@ -96,7 +98,9 @@ __all__ = [
     "Recorder",
     "check_kv",
     "check_register",
+    "collapse_retries",
     "election_safety",
+    "exactly_once",
     "lease_safety",
     "monotonic_reads",
     "monotonic_reads_strict",

@@ -72,8 +72,10 @@ from .history import (
 __all__ = [
     "HistoryScreen",
     "as_screens",
+    "collapse_retries_cols",
     "default_screens",
     "election_safety",
+    "exactly_once",
     "fold_verified",
     "lease_safety",
     "monotonic_reads",
@@ -312,6 +314,65 @@ def _shard_ok(word, count, own_op: int, write_op: int):
     return ~(jnp.any(c1) | jnp.any(c2))
 
 
+def _exactly_once_ok(word, count, apply_op: int):
+    """Per-seed ``exactly_once``: no two successful apply records share
+    (client, key) — the same off-diagonal pairwise pass as the numpy
+    detector (key = the op id, attempt bits stripped at the recorder)."""
+    h_dim = word.shape[0]
+    if h_dim == 0:
+        return jnp.bool_(True)
+    idx = jnp.arange(h_dim, dtype=jnp.int32)
+    valid = idx < count
+    op, key, arg, client, ok = _cols(word)
+    m = valid & (op == apply_op) & (ok == OK_OK)
+    bad = (
+        m[:, None] & m[None, :]
+        & (key[:, None] == key[None, :])
+        & (client[:, None] == client[None, :])
+        & (idx[:, None] != idx[None, :])
+    )
+    return ~jnp.any(bad)
+
+
+def collapse_retries_cols(word, count):
+    """Device twin of ``check.vectorized.collapse_retries``: (S,H,5)
+    int32 word columns + (S,) counts -> word columns with every retry
+    re-send invoke's op code cleared to 0 (so it matches no kernel's op
+    mask; row count and buffer order untouched). An invoke collapses
+    iff an earlier invoke of the same (client, op, key) exists with no
+    response of that group between them — the same pairwise formula as
+    numpy, bit-identical by construction. Traceable; apply before
+    :func:`screen_ok` when a model records one invoke per delivered
+    retry attempt."""
+    h_dim = word.shape[1]
+    if h_dim == 0:
+        return word
+
+    def per_seed(w, c):
+        idx = jnp.arange(h_dim, dtype=jnp.int32)
+        valid = idx < c
+        op, key, _arg, client, okc = _cols(w)
+        inv = valid & (okc == OK_PENDING)
+        resp = valid & (okc != OK_PENDING)
+        same = (
+            (key[:, None] == key[None, :])
+            & (client[:, None] == client[None, :])
+            & (op[:, None] == op[None, :])
+        )
+        lower = idx[:, None] > idx[None, :]  # [j, i]: i strictly earlier
+        rcnt = jnp.sum(same & lower & resp[None, :], axis=1)
+        collapsed = inv & jnp.any(
+            same & lower & inv[None, :]
+            & (rcnt[:, None] == rcnt[None, :]),
+            axis=1,
+        )
+        return w.at[:, COL_OP].set(
+            jnp.where(collapsed, 0, w[:, COL_OP])
+        )
+
+    return jax.vmap(per_seed)(word, jnp.asarray(count))
+
+
 @dataclasses.dataclass(frozen=True)
 class HistoryScreen:
     """One vectorized detector as a device kernel + its numpy oracle.
@@ -370,6 +431,7 @@ class HistoryScreen:
             "shard_coverage": lambda: v.shard_coverage(
                 h, self.op_a, self.op_b
             ),
+            "exactly_once": lambda: v.exactly_once(h, self.op_a),
         }[self.kind]
         return fn()
 
@@ -389,6 +451,7 @@ _KERNELS = {
     "recovery_safety": lambda w, c, s: _recovery_ok(w, c, s.op_a, s.op_b),
     "lease_safety": lambda w, c, s: _lease_ok(w, c, s.op_a, s.op_b),
     "shard_coverage": lambda w, c, s: _shard_ok(w, c, s.op_a, s.op_b),
+    "exactly_once": lambda w, c, s: _exactly_once_ok(w, c, s.op_a),
 }
 
 
@@ -430,6 +493,14 @@ def shard_coverage(own_op: int, write_op: int):
     """Shard-migration screen (models/shardkv.py): double-serve and
     lost-range, ``check.vectorized.shard_coverage`` on device."""
     return HistoryScreen("shard_coverage", own_op, write_op)
+
+
+def exactly_once(apply_op: int):
+    """At-most-once-apply screen (the client-retry safety property,
+    models/shardkv.py army puts): ``check.vectorized.exactly_once`` on
+    device — the detector that catches retried non-idempotent applies
+    no final-state invariant can see."""
+    return HistoryScreen("exactly_once", apply_op, 0)
 
 
 def default_screens() -> tuple:

@@ -22,6 +22,14 @@ bucket resolution — a seed is flagged only when the quantile bucket's
 LOWER edge exceeds the bound, i.e. when the true quantile *provably*
 exceeds it. Breaches inside the same bucket as the bound are not
 flagged (under-flag, never false-flag — the vectorized-detector rule).
+
+Under a client-retry policy (``chaos.RetryPolicy``) the judged latency
+is attempt-collapsed by construction: the engine's per-op clocks span
+the FIRST attempt's invoke to the final response (lat_start is
+first-start-wins, core.py), so a breach here is the latency the end
+user saw across every re-send — retries can only widen it, never reset
+the clock. Give-ups leave the op uncompleted (it never folds into the
+sketch), the same undercount rule as a lost op without retries.
 """
 
 from __future__ import annotations

@@ -64,6 +64,8 @@ __all__ = [
     "recovery_safety",
     "lease_safety",
     "shard_coverage",
+    "exactly_once",
+    "collapse_retries",
 ]
 
 _MIN = np.int64(-(2**62))  # "no prior write" floor sentinel
@@ -368,6 +370,93 @@ def shard_coverage(h: BatchHistory, own_op: int, write_op: int) -> np.ndarray:
             om = own & (key == k)
             viol |= (om & (wmax > _MIN) & (ver < wmax)).any(axis=1)
     return ~viol
+
+
+def exactly_once(h: BatchHistory, apply_op: int) -> np.ndarray:
+    """At-most-once application (the client-retry safety property,
+    models/shardkv.py army puts): no operation is applied twice by the
+    state machine.
+
+    The workload records every APPLY — the moment a delivery actually
+    mutates state, not the delivery itself — on ``apply_op``/``OK_OK``
+    with key = the op id (retry attempt bits stripped; the arg may
+    carry the attempt for forensics, it is not judged). A seed is
+    flagged when two apply records share (client, key): the same
+    logical op took effect more than once, which is exactly what a
+    modeled retry (chaos.RetryPolicy) turns from impossible into
+    routine the moment an apply path is not idempotent. A correctly
+    deduplicating state machine produces zero duplicates by
+    construction no matter how aggressively the policy re-sends.
+
+    Pairwise over the history buffer (the election_safety cost shape) —
+    sized for op streams of hundreds of records, not millions.
+    """
+    valid, op, key, arg, client, ok = _cols(h)
+    m = valid & (op == apply_op) & (ok == OK_OK)
+    s_dim, h_dim = m.shape
+    if h_dim == 0:
+        return np.ones(s_dim, bool)
+    pair = m[:, :, None] & m[:, None, :]
+    same_key = key[:, :, None] == key[:, None, :]
+    same_client = client[:, :, None] == client[:, None, :]
+    off_diag = ~np.eye(h_dim, dtype=bool)[None, :, :]
+    return ~(pair & same_key & same_client & off_diag).any(axis=(1, 2))
+
+
+def collapse_retries(h: BatchHistory) -> BatchHistory:
+    """Collapse retried invokes into one invocation interval per op.
+
+    A model that records an invoke per DELIVERY (one per retry attempt)
+    gives the FIFO invoke/response pairing several pending invokes for
+    one logical op: the response then pairs the oldest attempt — which
+    is the correct interval (latency clocks span first attempt ->
+    final response) — but every later attempt's invoke lingers as a
+    spurious pending op, and the floor detectors
+    (:func:`read_your_writes` / :func:`stale_reads` /
+    :func:`monotonic_reads`) would rank-match some FUTURE response to
+    it, skewing intervals. This pass rewrites the history so each
+    (client, op, key) carries at most one open invoke at a time: an
+    invoke arriving while an earlier invoke of the same (client, op,
+    key) is still unresponded is a retry re-send, and its record's op
+    code is cleared to 0 (matching no detector mask — the row count
+    and buffer order are untouched, so downstream index math is
+    unchanged).
+
+    The rule is stated over buffer (= dispatch) order: row j's invoke
+    collapses iff an earlier invoke of the same (client, op, key)
+    exists with no response of that (client, op, key) between them.
+    O(S·H²) pairwise, like the pairwise detectors; the device twin is
+    ``check.device.collapse_retries_cols`` (bit-identical by
+    construction — same masks, same formula).
+    """
+    valid, op, key, arg, client, ok = _cols(h)
+    s_dim, h_dim = valid.shape
+    if h_dim == 0:
+        return h
+    inv = valid & (ok == OK_PENDING)
+    resp = valid & (ok != OK_PENDING)
+    same = (
+        (key[:, :, None] == key[:, None, :])
+        & (client[:, :, None] == client[:, None, :])
+        & (op[:, :, None] == op[:, None, :])
+    )
+    lower = np.tril(np.ones((h_dim, h_dim), bool), k=-1)[None, :, :]
+    # per-row count of same-group responses strictly before it: two
+    # rows of one group share a "segment" iff these counts are equal,
+    # i.e. no group response lies between them
+    rcnt = (same & lower & resp[:, None, :]).sum(axis=2)
+    collapsed = (
+        inv
+        & (
+            same & lower & inv[:, None, :]
+            & (rcnt[:, :, None] == rcnt[:, None, :])
+        ).any(axis=2)
+    )
+    word = np.array(h.word, copy=True)
+    word[..., COL_OP] = np.where(collapsed, 0, word[..., COL_OP])
+    return BatchHistory(
+        word=word, t=h.t, count=h.count, drop=h.drop
+    )
 
 
 def election_safety(h: BatchHistory, elect_op: int) -> np.ndarray:

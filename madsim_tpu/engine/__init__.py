@@ -62,6 +62,13 @@ from .core import (  # noqa: E402,F401
     LatencySpec,
     N_LAT_BUCKETS,
     PlanRows,
+    RETRY_ATTEMPT_MAX,
+    RETRY_ATTEMPT_SHIFT,
+    RETRY_OP_MASK,
+    RETRY_STATE_FIELDS,
+    RetrySpec,
+    MET_RETRY,
+    MET_RETRY_GIVEUP,
     SimState,
     Workload,
     lat_bucket,
@@ -85,6 +92,9 @@ from .core import (  # noqa: E402,F401
     make_run_while,
     make_step,
     pack_slow_arg,
+    retry_token,
+    retry_token_attempt,
+    retry_token_op,
     time32_eligible,
     user_kind,
 )

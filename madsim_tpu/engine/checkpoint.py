@@ -50,14 +50,18 @@ _MANIFEST_KEY = "__madsim_manifest__"
 # index these ACCUMULATE (a Lamport clock is history, not a pure
 # function of the pool), so they are part of the format, not rebuilt
 # on load. Older checkpoints are rejected with the designed mismatch
-# error rather than a KeyError mid-load.
+# error rather than a KeyError mid-load; format 11: the client-retry
+# columns (rt_done/rt_attempt/rt_deadline, retry=RetrySpec) — CORE
+# state like the causal clocks (rt_done feeds the deliver gate and the
+# armed deadlines are history), zero-size off-policy so off-policy
+# checkpoints stay byte-comparable modulo the empty entries.
 #
 # The readiness-index tile summaries (POOL_INDEX_STATE_FIELDS, ISSUE
 # 13) are NOT part of the format: they are derived by construction
 # (a pure function of ev_time/ev_valid — engine.build_pool_index is
 # the definition), so save() skips them and load() rebuilds them for
 # whatever pool_index resolution the resumed run uses.
-_FORMAT = 10
+_FORMAT = 11
 
 
 def save(path: str, state: SimState, cfg: EngineConfig) -> None:
@@ -90,6 +94,7 @@ def load(
     cfg: EngineConfig,
     time32: bool | None = None,
     pool_index: bool | None = None,
+    retry=None,
 ) -> SimState:
     """Load a SimState; refuses a checkpoint taken under another config.
 
@@ -107,6 +112,14 @@ def load(
     file — they are REBUILT here from the loaded pool columns
     (``engine.build_pool_index``), which is what makes them derived
     state: the checkpoint format carries only ground truth.
+
+    ``retry``: the RetrySpec the resumed run will use (what you will
+    pass to the run builders), or None for an off-policy resume. The
+    retry columns are CORE state (armed deadlines are history), so a
+    checkpoint taken under one policy shape cannot resume under
+    another: a mismatch between the saved ``rt_done`` width and the
+    declared ``retry.n_ops`` is refused here with the shape named,
+    rather than surfacing as a jit shape error mid-resume.
     """
     with np.load(path) as data:
         manifest = json.loads(bytes(data[_MANIFEST_KEY]).decode())
@@ -144,4 +157,16 @@ def load(
                 "so a checkpoint saved on another platform will not resume "
                 "under the default)"
             )
+    saved_ops = int(np.asarray(state.rt_done).shape[-1])
+    want_ops = 0 if retry is None else int(retry.n_ops)
+    if saved_ops != want_ops:
+        raise ValueError(
+            f"checkpoint carries retry columns for {saved_ops} ops but the "
+            f"resumed run declared "
+            f"{'no retry policy' if retry is None else f'retry.n_ops={want_ops}'}"
+            "; armed retry deadlines are core state, so resume with the "
+            "checkpoint's own RetrySpec (or an off-policy checkpoint "
+            "off-policy) — pass the matching retry= here and to "
+            "make_run/make_run_while/make_run_compacted"
+        )
     return state

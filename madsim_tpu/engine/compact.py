@@ -143,6 +143,7 @@ def make_run_compacted(
     rank_place_max_pool: int | None = None,
     hist_screen=None,
     causal: bool = False,
+    retry=None,
 ):
     """Build ``run(state) -> SimpleNamespace`` of per-original-seed results.
 
@@ -174,7 +175,7 @@ def make_run_compacted(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool, causal,
+        pool_index, rank_place_max_pool, causal, retry=retry,
     ))
     all_names = [f.name for f in dataclasses.fields(SimState)]
     for f in fields:

@@ -67,6 +67,7 @@ from .rng import (
     PURPOSE_LATENCY,
     PURPOSE_LOSS,
     PURPOSE_POLL_COST,
+    PURPOSE_RETRY,
     PURPOSE_TORN,
     PURPOSE_USER,
     Draw,
@@ -85,6 +86,16 @@ __all__ = [
     "lat_bucket_hi",
     "Workload",
     "SimState",
+    "RetrySpec",
+    "RETRY_ATTEMPT_SHIFT",
+    "RETRY_ATTEMPT_MAX",
+    "RETRY_OP_MASK",
+    "RETRY_STATE_FIELDS",
+    "retry_token",
+    "retry_token_op",
+    "retry_token_attempt",
+    "MET_RETRY",
+    "MET_RETRY_GIVEUP",
     "Emits",
     "EmitBuilder",
     "HandlerCtx",
@@ -476,12 +487,17 @@ MET_TORN = 15  # kills that landed inside an armed torn-write window
 #                write being outstanding — on a correct fsync-everywhere
 #                model nothing ever is, which is the theorem, so this
 #                counts the exercised windows, not the data damage)
-N_METRICS = 16
+# client-retry counters (RetrySpec; always 0 without a policy). Appended
+# after MET_TORN so every pre-existing slot id is stable.
+MET_RETRY = 16  # army re-deliveries dispatched (attempt > 0 that ran)
+MET_RETRY_GIVEUP = 17  # ops abandoned: the max_attempts-th timer fired
+#                        with no response recorded — at-least-once gave up
+N_METRICS = 18
 
 METRIC_NAMES = (
     "sent", "delivered", "lost", "dead_drop", "dup", "crash", "restart",
     "pause", "clog_block", "timer", "record", "rng_blocks", "halt_code",
-    "sync", "sync_lost", "torn",
+    "sync", "sync_lost", "torn", "retry", "retry_giveup",
 )
 
 # ---------------------------------------------------------------------------
@@ -556,6 +572,156 @@ class LatencySpec:
             raise ValueError(
                 f"LatencySpec.phase_ns must be >= 1, got {self.phase_ns}"
             )
+
+
+# ---------------------------------------------------------------------------
+# Client-retry token packing (madsim_tpu.chaos RetryPolicy). A retried
+# op rides the SAME user kind as the original offer; the attempt id is
+# packed into the high bits of the op token (args[0]) so handlers,
+# history records and the Perfetto sidecar can tell re-sends apart while
+# attempt-0 tokens stay PLAIN op ids — the bit-identity-off-policy
+# invariant costs nothing to state: with no policy, no attempt is ever
+# nonzero, so every token is the pre-retry value.
+# ---------------------------------------------------------------------------
+RETRY_ATTEMPT_SHIFT = 26
+RETRY_ATTEMPT_MAX = 15  # attempt ids 0..15 fit bits 26..29 (sign bit free)
+RETRY_OP_MASK = (1 << RETRY_ATTEMPT_SHIFT) - 1
+
+
+def retry_token(op, attempt):
+    """Pack (op id, attempt id) into an op token. Host or traced."""
+    return op | (attempt << RETRY_ATTEMPT_SHIFT)
+
+
+def retry_token_op(token):
+    """The plain op id of a token (identity for attempt-0 tokens)."""
+    return token & RETRY_OP_MASK
+
+
+def retry_token_attempt(token):
+    """The attempt id of a token (0 for plain pre-retry tokens)."""
+    return (token >> RETRY_ATTEMPT_SHIFT) & RETRY_ATTEMPT_MAX
+
+
+# backoff entries are clipped host-side so the traced jitter product
+# (entry * uint32 draw) stays inside int64: cap * 2^32 < 2^63
+_RETRY_BACKOFF_CAP = 2**31 - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrySpec:
+    """Build parameters of the engine's client-retry timer mechanism.
+
+    The compiled form of ``chaos.RetryPolicy`` attached to a
+    ``ClientArmy``: ``kind``/``node``/``op_base``/``n_ops`` identify
+    the army's offered ops (one retry-state slot per op), the policy
+    fields drive the timers. Each delivered army attempt arms ONE
+    follow-up pool row at ``now + timeout_ns + backoff + jitter`` with
+    the attempt id incremented; when it pops, the op is re-delivered
+    unless a response was recorded meanwhile (the op's ``lat_end``
+    marker — the same first-response-wins discipline the latency tap
+    uses, which is why a retry build requires ``Workload.lat_markers``).
+    ``max_attempts`` counts total deliveries: the row carrying attempt
+    id ``max_attempts`` is the give-up sentinel — it never delivers,
+    only closes the books (MET_RETRY_GIVEUP). Backoff before attempt
+    ``a >= 1`` is ``backoff_base_ns * backoff_mult**(a-1)``, jittered
+    by a fresh PURPOSE_RETRY threefry draw scaled to ``[0, jitter]`` of
+    the backoff — per (seed, step), so the schedule is seed-pure.
+    Hashable (frozen), so it keys the compiled-run caches like every
+    other build flag.
+    """
+
+    kind: int
+    node: int
+    op_base: int
+    n_ops: int
+    timeout_ns: int
+    max_attempts: int = 3
+    backoff_base_ns: int = 0
+    backoff_mult: float = 2.0
+    jitter: float = 0.0
+
+    def __post_init__(self):
+        if self.n_ops < 1:
+            raise ValueError(f"RetrySpec.n_ops must be >= 1, got {self.n_ops}")
+        if self.timeout_ns < 1:
+            raise ValueError(
+                f"RetrySpec.timeout_ns must be >= 1, got {self.timeout_ns}"
+            )
+        if not (1 <= self.max_attempts <= RETRY_ATTEMPT_MAX):
+            raise ValueError(
+                f"RetrySpec.max_attempts must be in 1..{RETRY_ATTEMPT_MAX} "
+                f"(the token packs attempts into 4 bits), got "
+                f"{self.max_attempts}"
+            )
+        if self.op_base < 0:
+            raise ValueError(
+                f"RetrySpec.op_base must be >= 0, got {self.op_base}"
+            )
+        if self.op_base + self.n_ops - 1 > RETRY_OP_MASK:
+            raise ValueError(
+                f"RetrySpec op ids reach {self.op_base + self.n_ops - 1}, "
+                f"past the {RETRY_ATTEMPT_SHIFT}-bit token op field "
+                f"(max {RETRY_OP_MASK})"
+            )
+        if not (FIRST_USER_KIND <= self.kind < FIRST_EXT_KIND):
+            raise ValueError(
+                f"RetrySpec.kind={self.kind} must be a user kind "
+                f"(in [{FIRST_USER_KIND}, {FIRST_EXT_KIND}))"
+            )
+        if self.backoff_base_ns < 0:
+            raise ValueError(
+                f"RetrySpec.backoff_base_ns must be >= 0, got "
+                f"{self.backoff_base_ns}"
+            )
+        if self.backoff_mult < 1.0:
+            raise ValueError(
+                f"RetrySpec.backoff_mult must be >= 1, got "
+                f"{self.backoff_mult}"
+            )
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError(
+                f"RetrySpec.jitter must be in [0, 1], got {self.jitter}"
+            )
+
+
+def _retry_backoff_tables(rt: RetrySpec):
+    """Host-side backoff tables, indexed by the NEXT attempt id.
+
+    Entry ``a`` is the deterministic backoff before delivering attempt
+    ``a`` (0 for a=0/1 when base is 0), clipped to the int64-safe cap;
+    the jit table is the maximum jitter addend (``backoff * jitter``,
+    same cap) that the uint32 draw scales down. Both are plain Python
+    int tuples — compiled into the step as constants.
+    """
+    boff = [0]
+    for a in range(1, rt.max_attempts + 1):
+        b = rt.backoff_base_ns * rt.backoff_mult ** (a - 1)
+        boff.append(min(int(b), _RETRY_BACKOFF_CAP))
+    bjit = [min(int(b * rt.jitter), _RETRY_BACKOFF_CAP) for b in boff]
+    return tuple(boff), tuple(bjit)
+
+
+def _check_retry(wl: "Workload", retry: "RetrySpec | None") -> int:
+    """Validate a retry build parameter; returns n_ops (0 = off).
+
+    Shared by make_init and make_step so no mismatched pair of builders
+    can be constructed (the _check_obs discipline).
+    """
+    if retry is None:
+        return 0
+    if not isinstance(retry, RetrySpec):
+        raise TypeError(
+            f"retry must be a RetrySpec or None, got {type(retry).__name__}"
+        )
+    if wl.lat_markers == 0:
+        raise ValueError(
+            "retry needs a workload with latency markers "
+            "(Workload.lat_markers > 0): the response-deadline timer is "
+            "disarmed by the op's lat_end marker, so a model that never "
+            "marks responses would retry forever"
+        )
+    return retry.n_ops
 
 
 # MET_HALT_CODE values
@@ -636,6 +802,16 @@ POOL_INDEX_STATE_FIELDS = ("tile_min", "tile_cnt")
 CAUSAL_STATE_FIELDS = (
     "lam", "ev_parent", "ev_lam", "tl_seq", "tl_parent", "tl_lam",
 )
+
+# the client-retry columns (RetrySpec, ISSUE 20): CORE state, not
+# derived — rt_done feeds the deliver/suppress gate, so retried
+# trajectories legitimately depend on it — but zero-size when no policy
+# is attached (the usual off-axis discipline: retry-off runs are
+# bit-identical to pre-retry builds). Named separately for the same
+# schema-sensitive consumers CAUSAL_STATE_FIELDS serves: excluding the
+# field NAMES keeps pre-retry golden digests valid for retry-off
+# builds; the off-state value identity is pinned by tests/test_retry.py.
+RETRY_STATE_FIELDS = ("rt_done", "rt_attempt", "rt_deadline")
 
 
 def derived_fields(wl: "Workload") -> tuple:
@@ -878,6 +1054,17 @@ def column_contracts(
         c("lat_hist", 0, cnt, "counter"),
         c("lat_count", 0, cnt, "counter"),
         c("lat_drop", 0, cnt, "counter"),
+        # client-retry columns (RetrySpec): attempt ids are token-packed
+        # 4-bit values; the deadline clock is ALWAYS absolute int64
+        # (observability-friendly even under time32 — it never feeds the
+        # pool), bounded by the horizon plus one timer arm's offset and
+        # the int64-capped backoff+jitter
+        c("rt_done", 0, 1),
+        c("rt_attempt", 0, RETRY_ATTEMPT_MAX, "counter",
+          "delivered attempt id per op"),
+        c("rt_deadline", 0,
+          h + offset_hi + 2 * _RETRY_BACKOFF_CAP, "time",
+          "absolute ns; armed response deadline per op"),
         tile_min,
         c("tile_cnt", 0, max(pool_tile(cfg.pool_size), 64), "counter"),
     ]
@@ -1683,6 +1870,15 @@ class SimState:
     lat_hist: jnp.ndarray  # (P, B) int32 latency sketch
     lat_count: jnp.ndarray  # () int32 completed ops folded into the sketch
     lat_drop: jnp.ndarray  # () int32 markers with out-of-range op ids (loud)
+    # client-retry columns (make_step's ``retry``; CR = RetrySpec.n_ops,
+    # 0 with no policy — zero-size, zero cost, bit-identical). CORE
+    # state, not derived: rt_done gates re-delivery, so retried
+    # trajectories depend on it (see RETRY_STATE_FIELDS). rt_deadline is
+    # ALWAYS absolute int64 — it never feeds the pool clock, so the
+    # time32 representation does not apply (forensics read it directly).
+    rt_done: jnp.ndarray  # (CR,) bool: response recorded for op
+    rt_attempt: jnp.ndarray  # (CR,) int32: last DELIVERED attempt id
+    rt_deadline: jnp.ndarray  # (CR,) int64: armed deadline, absolute ns
     # readiness-partitioned pool index (make_step's ``pool_index``; NT =
     # pool_size/tile when on, else 0 — zero-size, zero cost, the usual
     # off discipline). Derived by construction from (ev_time, ev_valid)
@@ -1809,6 +2005,7 @@ def make_init(
     latency: LatencySpec | None = None,
     pool_index: bool | None = None,
     causal: bool = False,
+    retry: "RetrySpec | None" = None,
 ):
     """Build ``init(seeds) -> SimState`` (batched over the seeds array).
 
@@ -1854,6 +2051,7 @@ def make_init(
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
     _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
+    rt_c = _check_retry(wl, retry)
     del k
     w = wl.payload_words
     h = wl.history.capacity if wl.history is not None else 0
@@ -1991,6 +2189,9 @@ def make_init(
             lat_hist=jnp.zeros((lat_p, N_LAT_BUCKETS if lat_c else 0), jnp.int32),
             lat_count=jnp.int32(0),
             lat_drop=jnp.int32(0),
+            rt_done=jnp.zeros((rt_c,), jnp.bool_),
+            rt_attempt=jnp.zeros((rt_c,), jnp.int32),
+            rt_deadline=jnp.zeros((rt_c,), jnp.int64),
             tile_min=tile_min,
             tile_cnt=tile_cnt,
         )
@@ -2081,6 +2282,7 @@ def make_step(
     pool_index: bool | None = None,
     rank_place_max_pool: int | None = None,
     causal: bool = False,
+    retry: "RetrySpec | None" = None,
     _lat_export: bool = False,
 ):
     """Build the single-seed ``step(SimState) -> SimState`` function.
@@ -2244,6 +2446,14 @@ def make_step(
     _check_meta_ranges(wl)
     _check_cov_words(cov_words)
     _check_obs(cov_words, cov_hitcount, timeline_cap, latency)
+    rt_c = _check_retry(wl, retry)
+    if rt_c:
+        rt_boff, rt_bjit = _retry_backoff_tables(retry)
+        rt_kind = np.int32(retry.kind)
+        rt_node = np.int32(retry.node)
+        rt_base = np.int32(retry.op_base)
+        rt_max = np.int32(retry.max_attempts)
+        rt_timeout = np.int64(retry.timeout_ns)
     layout = resolve_layout(layout)
     dense = layout == "dense"
     pool_index = _resolve_pool_index(cfg, pool_index, dense=dense)
@@ -2284,6 +2494,17 @@ def make_step(
                 "or the in-step latency tap (hunt runs)"
             )
     time32 = _resolve_time32(wl, cfg, time32)
+    if rt_c and time32:
+        # a retry timer's delay must fit the int32 offset form like any
+        # declared timer bound (the delay_bound_ns eligibility rule)
+        rt_worst = int(rt_timeout) + rt_boff[-1] + rt_bjit[-1]
+        lim32 = _T32_LIMIT - cfg.proc_max_ns - 1
+        if rt_worst > lim32:
+            raise ValueError(
+                f"retry timeout + max backoff + max jitter = {rt_worst} ns "
+                f"exceeds the int32 offset horizon ({lim32} ns); shrink "
+                f"the policy or build with time32=False"
+            )
     t_inf = _INF_32 if time32 else _INF_NS
 
     # -- user branch table -------------------------------------------------
@@ -2556,6 +2777,36 @@ def make_step(
         blocked = clogged | held
         dispatch = active & ~blocked & (is_engine | live)
 
+        # ---- client-retry decode (RetrySpec; rt_c=0 compiles the whole
+        # mechanism away). An army row — the original offer or an armed
+        # re-send timer — is a USER-kind dispatch of the policy's kind
+        # at the army node whose token op id falls in the policy's op
+        # range; the attempt id rides the token high bits (attempt-0
+        # tokens are plain op ids, the off-policy bit-identity). A row
+        # whose op already saw a response (rt_done) or that carries the
+        # give-up sentinel attempt (== max_attempts) is SUPPRESSED: it
+        # dispatches as a no-op — handler effects, emits and records
+        # dropped; only the trace fold and the retry books see it.
+        if rt_c:
+            rt_tok = args[0]
+            rt_idx = (rt_tok & jnp.int32(RETRY_OP_MASK)) - rt_base
+            rt_att = (
+                rt_tok >> jnp.int32(RETRY_ATTEMPT_SHIFT)
+            ) & jnp.int32(RETRY_ATTEMPT_MAX)
+            rt_in_r = (rt_idx >= 0) & (rt_idx < rt_c)
+            is_army = (
+                dispatch & ~is_engine & (kind == rt_kind)
+                & (dst == rt_node) & rt_in_r
+            )
+            rt_ids = jnp.arange(rt_c, dtype=jnp.int32)
+            rt_oh = rt_ids == rt_idx  # (CR,); all-False out of range
+            rt_done_i = jnp.any(st.rt_done & rt_oh)
+            rt_deliver = ~rt_done_i & (rt_att < rt_max)
+            rt_suppress = is_army & ~rt_deliver
+            rt_arm = is_army & rt_deliver
+        else:
+            rt_suppress = jnp.asarray(False)
+
         # ---- causal provenance fold (causal=True; derived state only,
         # the ev_emit discipline: everything below is read exclusively
         # into more causal columns / the ring, never the trajectory) ----
@@ -2614,6 +2865,11 @@ def make_step(
         i_torn = len(lane_p)
         if sync_on:
             lane_p.append(PURPOSE_TORN)
+        # retry backoff jitter: one fresh lane per dispatch (a re-send's
+        # jitter is keyed by the ARMING step, seed-pure like everything)
+        i_retry = len(lane_p)
+        if rt_c:
+            lane_p.append(PURPOSE_RETRY)
         # user lanes (Workload.draw_purposes): handler draws at these
         # purposes ride the same block; ctx.draw serves them from a
         # trace-time lane cache (rng.Draw.from_parts) so no branch
@@ -2728,6 +2984,10 @@ def make_step(
             # chaos-only workload: no user branches to run
             user_state, uem = state_row, Emits.none(k, w, aw, rr, ll)
         user_dispatch = dispatch & ~is_engine
+        if rt_c:
+            # suppressed army rows are no-ops: the branch ran (a switch
+            # always does) but none of its effects apply
+            user_dispatch = user_dispatch & ~rt_suppress
 
         # ---- apply node-state update (an OOB dst matches no row in the
         # dense form, exactly the dropped-scatter semantics) ----
@@ -2909,8 +3169,11 @@ def make_step(
         # read their slot's latency/loss draws, so the extra slot is
         # trace-neutral
         restart_row = kind == KIND_RESTART
+        # user emit rows are also dropped for suppressed army rows (the
+        # retry no-op rule); without a policy this is exactly ~is_engine
+        user_row_ok = (~is_engine & ~rt_suppress) if rt_c else ~is_engine
         em = Emits(
-            valid=jnp.concatenate([uem.valid & ~is_engine, restart_row[None]]),
+            valid=jnp.concatenate([uem.valid & user_row_ok, restart_row[None]]),
             send=jnp.concatenate([uem.send, jnp.zeros((1,), jnp.bool_)]),
             kind=jnp.concatenate(
                 [uem.kind, jnp.full((1,), FIRST_USER_KIND, jnp.int32)]
@@ -2932,7 +3195,7 @@ def make_step(
         # arrives at its own time and is lost on its own coin, exactly
         # like a real duplicate in flight.
         if dup_rows:
-            dvalid = uem.valid & ~is_engine & uem.send & st.dup
+            dvalid = uem.valid & user_row_ok & uem.send & st.dup
             em = Emits(
                 valid=jnp.concatenate([em.valid, dvalid]),
                 send=jnp.concatenate([em.send, uem.send]),
@@ -2946,6 +3209,58 @@ def make_step(
             )
         lat_bits = lane0[1 : 1 + n_em_lanes]
         loss_bits = lane1[1 : 1 + n_em_lanes]
+        if rt_c:
+            # the armed re-send: ONE timer row per delivered army
+            # attempt, appended last — the next attempt's token at
+            # now + timeout + backoff + jitter, addressed to the army
+            # node on the army kind. A timer (send=False) never reads
+            # its latency/loss lane, draws no loss coin and rides the
+            # standard epoch copy (a pending retry dies with its client
+            # incarnation, exactly like any other timer). Backoff is an
+            # unrolled table select — no gathers, layout-identical.
+            rt_next = rt_att + jnp.int32(1)
+            rt_boff_t = jnp.int64(0)
+            rt_bjit_t = jnp.int64(0)
+            for a in range(1, int(rt_max) + 1):
+                rt_boff_t = jnp.where(
+                    rt_next == a, jnp.int64(rt_boff[a]), rt_boff_t
+                )
+                rt_bjit_t = jnp.where(
+                    rt_next == a, jnp.int64(rt_bjit[a]), rt_bjit_t
+                )
+            # jitter scales the capped max addend by a uint32 draw:
+            # (cap * draw) >> 32 is exact integer arithmetic inside
+            # int64 (the _RETRY_BACKOFF_CAP bound)
+            rt_jit = (
+                rt_bjit_t * lane0[i_retry].astype(jnp.int64)
+            ) >> jnp.int64(32)
+            rt_delay = jnp.int64(rt_timeout) + rt_boff_t + rt_jit
+            rt_new_tok = (rt_tok & jnp.int32(RETRY_OP_MASK)) | (
+                rt_next << jnp.int32(RETRY_ATTEMPT_SHIFT)
+            )
+            rt_args_row = jnp.where(
+                jnp.arange(aw, dtype=jnp.int32) == 0, rt_new_tok, args
+            )
+            em = Emits(
+                valid=jnp.concatenate([em.valid, rt_arm[None]]),
+                send=jnp.concatenate([em.send, jnp.zeros((1,), jnp.bool_)]),
+                kind=jnp.concatenate(
+                    [em.kind, jnp.full((1,), int(rt_kind), jnp.int32)]
+                ),
+                dst=jnp.concatenate(
+                    [em.dst, jnp.full((1,), int(rt_node), jnp.int32)]
+                ),
+                delay=jnp.concatenate([em.delay, rt_delay[None]]),
+                args=jnp.concatenate([em.args, rt_args_row[None, :]]),
+                pay=jnp.concatenate([em.pay, jnp.zeros((1, w), jnp.int32)]),
+                rec_valid=em.rec_valid,
+                rec=em.rec,
+            )
+            # keep the row/lane axes aligned: the timer row's lane is
+            # never read (send=False), a zero entry suffices
+            rt_zlane = jnp.zeros((1,), jnp.uint32)
+            lat_bits = jnp.concatenate([lat_bits, rt_zlane])
+            loss_bits = jnp.concatenate([loss_bits, rt_zlane])
         span = jnp.uint32(max(cfg.lat_max_ns - cfg.lat_min_ns, 1))
         if time32:  # same value, native width (lat_max fits by eligibility)
             latency = jnp.int32(cfg.lat_min_ns) + (lat_bits % span).astype(jnp.int32)
@@ -3502,6 +3817,36 @@ def make_step(
             lat_hist = st.lat_hist
             lat_count, lat_drop = st.lat_count, st.lat_drop
 
+        # ---- client-retry books (RetrySpec; CORE state — rt_done
+        # gates the deliver/suppress decision above) ----
+        if rt_c:
+            # response bookkeeping: the model's lat_end marker for an op
+            # (phase word 1) disarms its timer — first-response-wins,
+            # the same discipline the latency tap applies, which is why
+            # a retry build requires lat_markers. Markers carry the
+            # STRIPPED op id (models strip attempt bits), so the slot
+            # index is id - op_base whatever the delivered attempt was.
+            rt_done = st.rt_done
+            for j in range(ll):
+                rt_mv = (
+                    user_dispatch
+                    & uem.lat_valid[j]
+                    & (uem.lat[j, 1] == jnp.int32(1))
+                )
+                rt_done = rt_done | (
+                    (rt_ids == (uem.lat[j, 0] - rt_base)) & rt_mv
+                )
+            # the delivered-attempt ledger and the armed deadline
+            # (absolute ns even under time32 — forensics columns never
+            # feed the pool clock)
+            rt_attempt = jnp.where(rt_oh & rt_arm, rt_att, st.rt_attempt)
+            rt_deadline = jnp.where(
+                rt_oh & rt_arm, now_after + rt_delay, st.rt_deadline
+            )
+        else:
+            rt_done = st.rt_done
+            rt_attempt, rt_deadline = st.rt_attempt, st.rt_deadline
+
         # ---- coverage taps (madsim_tpu.explore) ----
         # derived state only: features of the event just dispatched are
         # hashed into an AFL-style bitmap. Nothing here feeds back into
@@ -3706,8 +4051,9 @@ def make_step(
             inc[MET_LOST] = i32(sent_m & lost)
             inc[MET_DEAD_DROP] = i32(sent_m & ~lost & ~alive_at_dst)
             if dup_rows:
-                # shadow rows are the last K emit slots (the dup block)
-                inc[MET_DUP] = i32(e_valid[k + 1:])
+                # shadow rows are the K emit slots after the restart row
+                # (the retry timer row, when compiled, follows them)
+                inc[MET_DUP] = i32(e_valid[k + 1 : 2 * k + 1])
             inc[MET_CRASH] = (dispatch & (kind == KIND_KILL)).astype(jnp.int32)
             inc[MET_RESTART] = (
                 dispatch & (kind == KIND_RESTART)
@@ -3724,12 +4070,22 @@ def make_step(
             # bookkeeping, not instrumentation of the RNG itself
             blocks = 1 + (k + 1) + (k if dup_rows else 0) + (
                 1 if sync_on else 0
-            )
+            ) + (1 if rt_c else 0)
             inc[MET_RNG] = jnp.where(active, jnp.int32(blocks), 0)
             if sync_on:
                 inc[MET_SYNC] = do_sync.astype(jnp.int32)
                 inc[MET_SYNC_LOST] = sync_lied.astype(jnp.int32)
                 inc[MET_TORN] = tore.astype(jnp.int32)
+            if rt_c:
+                # a re-delivery = a DELIVERED army row past attempt 0; a
+                # give-up = the max_attempts sentinel popping with the
+                # op still unanswered. (A sentinel row that dies with a
+                # killed client incarnation is an undercount — the epoch
+                # gate drops it before the books see it.)
+                inc[MET_RETRY] = (rt_arm & (rt_att > 0)).astype(jnp.int32)
+                inc[MET_RETRY_GIVEUP] = (
+                    is_army & ~rt_done_i & (rt_att == rt_max)
+                ).astype(jnp.int32)
             met = st.met + jnp.stack(inc)
             new_halt = halted & ~st.halted
             code = jnp.where(
@@ -3862,6 +4218,9 @@ def make_step(
             lat_hist=lat_hist,
             lat_count=lat_count,
             lat_drop=lat_drop,
+            rt_done=rt_done,
+            rt_attempt=rt_attempt,
+            rt_deadline=rt_deadline,
             tile_min=tile_min_out,
             tile_cnt=tile_cnt_out,
         )
@@ -3999,6 +4358,7 @@ def make_run(
     rank_place_max_pool: int | None = None,
     cold_split: bool = False,
     causal: bool = False,
+    retry: "RetrySpec | None" = None,
 ):
     """Build ``run(state) -> state``: n_steps of vmapped lockstep advance.
 
@@ -4026,7 +4386,8 @@ def make_run(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool, causal, _lat_export=cold,
+        pool_index, rank_place_max_pool, causal, retry=retry,
+        _lat_export=cold,
     ))
 
     if cold:
@@ -4068,6 +4429,7 @@ def make_run_while(
     rank_place_max_pool: int | None = None,
     cold_split: bool = False,
     causal: bool = False,
+    retry: "RetrySpec | None" = None,
 ):
     """Like :func:`make_run` but stops as soon as every seed has halted.
 
@@ -4088,7 +4450,8 @@ def make_run_while(
     step = jax.vmap(make_step(
         wl, cfg, layout, time32, dup_rows, cov_words,
         metrics, timeline_cap, cov_hitcount, latency, placement,
-        pool_index, rank_place_max_pool, causal, _lat_export=cold,
+        pool_index, rank_place_max_pool, causal, retry=retry,
+        _lat_export=cold,
     ))
     advance = (
         _cold_split_body(step, _make_cold_lat_apply(latency, wl.lat_markers))

@@ -66,6 +66,7 @@ __all__ = [
     "PURPOSE_LOSS",
     "PURPOSE_DUP",
     "PURPOSE_TORN",
+    "PURPOSE_RETRY",
     "PURPOSE_PLAN",
     "PURPOSE_EXPLORE",
     "PURPOSE_CLIENT",
@@ -141,6 +142,11 @@ class PurposeLane:
 #                uncommitted durable write survive. Only drawn for
 #                Workload.durable_sync workloads; counter-addressed, so
 #                enabling the discipline never shifts any other draw.
+#   retry      — client-retry backoff jitter (chaos.RetryPolicy): when
+#                a dispatched army op arms its response-deadline timer,
+#                ONE block draws the jitter fraction of the next
+#                attempt's backoff delay. Counter-addressed like torn:
+#                attaching a retry policy never shifts any other draw.
 #   latency    — per-emit-slot draws at base+slot: latency (lane 0)
 #                and loss (lane 1) from one block (Draw.bits2).
 #   dup        — duplicated-delivery draws (chaos KIND_DUP_ON): shadow
@@ -168,6 +174,7 @@ PURPOSE_LANES = (
     PurposeLane("poll_cost", 0, 1, "engine", "cost lane 0 / jitter lane 1"),
     PurposeLane("clog_jitter", 1, 1, "engine", "reserved/legacy"),
     PurposeLane("torn", 2, 1, "engine", "torn-write prefix draw"),
+    PurposeLane("retry", 3, 1, "engine", "retry backoff jitter draw"),
     PurposeLane("latency", 8, 56, "engine", "base+slot, lat/loss pair"),
     PurposeLane("dup", 64, 64, "engine", "base+slot, dup shadow pair"),
     PurposeLane("user", 128, 0x9E370000 - 128, "user", "base+user purpose"),
@@ -248,6 +255,7 @@ def validate_user_purposes(purposes, what: str = "draw_purposes") -> None:
 PURPOSE_POLL_COST = lane("poll_cost").base
 PURPOSE_CLOG_JITTER = lane("clog_jitter").base
 PURPOSE_TORN = lane("torn").base
+PURPOSE_RETRY = lane("retry").base
 PURPOSE_LATENCY = lane("latency").base  # + emit slot, both lanes used
 PURPOSE_DUP = lane("dup").base  # + shadow emit slot
 PURPOSE_LOSS = PURPOSE_DUP  # legacy alias: the retired per-slot loss range

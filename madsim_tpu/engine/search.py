@@ -87,7 +87,7 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
                     timeline_cap: int = 0, cov_hitcount: bool = False,
                     latency=None, compact: bool = False,
                     pool_index: bool | None = None, hist_screen=None,
-                    causal: bool = False):
+                    causal: bool = False, retry=None):
     # the ONE construction of a batched sweep's (init, run) pair —
     # make_sweep (the device-composable form) and search_seeds' cached
     # runner both build through here, so a flag added to one path cannot
@@ -108,6 +108,7 @@ def _build_init_run(wl: Workload, cfg: EngineConfig, max_steps: int, *,
     obs_kw = dict(
         metrics=metrics, timeline_cap=timeline_cap,
         cov_hitcount=cov_hitcount, latency=latency, causal=causal,
+        retry=retry,
     )
     init = make_init(wl, cfg, plan_slots=plan_slots, cov_words=cov_words,
                      pool_index=pool_index, **obs_kw)
@@ -142,6 +143,7 @@ def make_sweep(
     latency=None,
     pool_index: bool | None = None,
     causal: bool = False,
+    retry=None,
 ):
     """Build the traceable batched sweep: ``sweep(seeds[, rows]) -> view``.
 
@@ -159,6 +161,7 @@ def make_sweep(
         dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
         timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
         latency=latency, pool_index=pool_index, causal=causal,
+        retry=retry,
     )
 
     def sweep(seeds, rows=None):
@@ -175,7 +178,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
                   cov_words: int = 0, metrics: bool = False,
                   timeline_cap: int = 0, cov_hitcount: bool = False,
                   latency=None, pool_index: bool | None = None,
-                  hist_screen=None, causal: bool = False):
+                  hist_screen=None, causal: bool = False, retry=None):
     # plan VALUES are runtime data (PlanRows arrays); only the slot count
     # and the dup-path flag shape the compiled program, so one cache
     # entry serves every plan of the same width. The env-defaulted
@@ -195,7 +198,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
     key = (id(wl), cfg.hash(), max_steps, layout, compact, plan_slots,
            dup_rows, cov_words, metrics, timeline_cap, cov_hitcount,
            latency, pool_index, resolve_rank_place_max_pool(),
-           hist_screen, causal)
+           hist_screen, causal, retry)
     if key not in _RUN_CACHE:
         # imported here: obs is a consumer of the engine — a module-level
         # import would run the whole obs package during engine import
@@ -206,7 +209,7 @@ def _compiled_run(wl: Workload, cfg: EngineConfig, max_steps: int, layout,
             dup_rows=dup_rows, cov_words=cov_words, metrics=metrics,
             timeline_cap=timeline_cap, cov_hitcount=cov_hitcount,
             latency=latency, compact=compact, pool_index=pool_index,
-            hist_screen=hist_screen, causal=causal,
+            hist_screen=hist_screen, causal=causal, retry=retry,
         )
         # make_run_compacted jits internally per growth stage (its
         # build wall stays inside dispatch — documented limitation)
@@ -448,6 +451,7 @@ def search_seeds(
     pool_index: bool | None = None,
     device_check=None,
     causal: bool = False,
+    retry=None,
 ) -> SearchReport:
     """Run ``n_seeds`` chaos schedules and evaluate ``invariant`` on the
     final states.
@@ -513,6 +517,13 @@ def search_seeds(
     (make_step docstring; value-identical, auto on for CPU scatter
     pools past the crossover) — it keys the compiled-run cache like
     every other build flag.
+
+    ``retry`` arms the client-retry timers (``engine.RetrySpec``, make_
+    step docstring). With ``plan`` it defaults to the plan's own policy
+    — ``plan.retry_spec()`` when some army carries a
+    ``chaos.RetryPolicy`` — so a policied plan sweeps retried without
+    further wiring; pass ``retry=`` explicitly on the ``plan_rows``
+    path (pre-compiled rows carry no policy object).
 
     ``causal=True`` folds exact causal provenance (make_step docstring):
     the final per-node Lamport clocks return as ``report.lam`` (S, N)
@@ -599,6 +610,8 @@ def search_seeds(
         rows = plan.compile_batch(seeds, wl=wl)
         if plan_hash is None:
             plan_hash = plan.hash()
+        if retry is None and hasattr(plan, "retry_spec"):
+            retry = plan.retry_spec()
     elif plan_rows is not None:
         rows = plan_rows
         plan_slots = int(np.asarray(rows.time).shape[1])
@@ -620,7 +633,7 @@ def search_seeds(
         # the lockstep path screens via _screen_prog, so its run cache
         # entry must stay shared with unscreened sweeps
         hist_screen=screens if compact else None,
-        causal=causal,
+        causal=causal, retry=retry,
     )
     if rows is not None:
         if _resolve_time32(wl, cfg, None):

@@ -476,7 +476,7 @@ def _build_programs(
     wl, cfg, space, *, invariant, batch, max_steps, cov_words, layout,
     require_halt, select_top, max_corpus, vcap, max_ops, inherit_seed_p,
     cov_hitcount, metrics, latency, mesh, seed_corpus, cache_key,
-    pool_index=None, history_check=None, causal=False,
+    pool_index=None, history_check=None, causal=False, retry=None,
 ):
     """Build one cache entry: the (uniform, breed, refs) triple.
 
@@ -503,7 +503,7 @@ def _build_programs(
         wl, cfg, max_steps, layout=layout, plan_slots=p_slots,
         dup_rows=dup, cov_words=cov_words, metrics=metrics,
         timeline_cap=0, cov_hitcount=cov_hitcount, latency=latency,
-        pool_index=pool_index, causal=causal,
+        pool_index=pool_index, causal=causal, retry=retry,
     )
     k_ov = len(seed_corpus)
     if k_ov:
@@ -823,6 +823,13 @@ class _CampaignSession:
                 f"batch={batch} does not split over {n_dev} mesh devices"
             )
         vcap = int(viol_cap) if viol_cap is not None else int(max_corpus)
+        # derive the engine retry build flag from the space plan's
+        # ClientArmy policy (the host driver's rule; LiteralPlan spaces
+        # have no retry_spec and run fire-and-forget)
+        retry = (
+            space.plan.retry_spec() if hasattr(space.plan, "retry_spec")
+            else None
+        )
         p_slots = space.slots
         cmax1 = int(max_corpus) + 1
         vcap1 = vcap + 1
@@ -922,7 +929,7 @@ class _CampaignSession:
             int(max_corpus), vcap, max_ops, float(inherit_seed_p),
             bool(cov_hitcount), bool(metrics), latency, _mesh_key(mesh),
             tuple(lp.hash() for lp in seed_corpus), pool_index,
-            bool(causal),
+            bool(causal), retry,
             # invariant identity of the device history screen: screens
             # are value-hashable literals, so equal screen sets share
             # programs across campaigns (the ROADMAP "invariant
@@ -940,7 +947,7 @@ class _CampaignSession:
                 metrics=metrics, latency=latency, mesh=mesh,
                 seed_corpus=seed_corpus, cache_key=key,
                 pool_index=pool_index, history_check=history_check,
-                causal=causal,
+                causal=causal, retry=retry,
             ),
         )
 

@@ -260,6 +260,7 @@ def replay_entry(
     timeline_cap: int = 0,
     latency=None,
     causal: bool = False,
+    retry=None,
 ) -> SearchReport:
     """Re-execute one corpus entry's exact ``(seed, plan)`` pair.
 
@@ -274,6 +275,13 @@ def replay_entry(
     state only, so the replayed trace still equals ``entry.trace``
     (``causal=True`` + ``timeline_cap`` is how a banked violation
     becomes an ``obs.causal_slice`` happens-before cone).
+
+    ``retry``: the ``engine.RetrySpec`` the campaign ran under (the
+    hunt derives it from the plan space's ClientArmy policy). A banked
+    entry's plan is a LiteralPlan — raw pool rows that no longer carry
+    the army's RetryPolicy — so a retried campaign's entries must be
+    replayed with the campaign's spec passed explicitly here, or the
+    replay runs the fire-and-forget engine and the trace diverges.
     """
     if dup_rows is None:
         dup_rows = bool(entry.plan.uses_dup())
@@ -289,7 +297,7 @@ def replay_entry(
         plan_rows=stack_plan_rows([entry.plan]),
         plan_hash=entry.plan.hash(), dup_rows=dup_rows,
         cov_words=cov_words, metrics=metrics, timeline_cap=timeline_cap,
-        latency=latency, causal=causal,
+        latency=latency, causal=causal, retry=retry,
     )
 
 
@@ -386,6 +394,14 @@ def run(
 
     if isinstance(space, FaultPlan):
         space = PlanSpace(space)
+    # the army's retry policy is an ENGINE build flag, not plan rows:
+    # mutated children are LiteralPlans whose attempt-0 tokens are plain
+    # op ids either way, so one spec (the space plan's) serves every
+    # generation — and replay_entry must be handed the same spec
+    retry = (
+        space.plan.retry_spec() if hasattr(space.plan, "retry_spec")
+        else None
+    )
     if cov_words < 1:
         raise ValueError("exploration needs cov_words >= 1 (the guidance)")
     if generations < 1 or batch < 1:
@@ -539,6 +555,7 @@ def run(
             plan_rows=rows, plan_hash=space.hash(), dup_rows=dup,
             cov_words=cov_words, cov_hitcount=cov_hitcount,
             latency=latency, pool_index=pool_index, causal=causal,
+            retry=retry,
         )
         t_after = _time.monotonic()  # lint: allow(wall-clock)
         # the trace/lower/compile share of this dispatch (nonzero only

@@ -31,7 +31,14 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..check.history import OK_OK, OK_PENDING, OP_READ, OP_WRITE
-from ..engine import KIND_KILL, KIND_RESTART, HistorySpec, Workload, user_kind
+from ..engine import (
+    KIND_KILL,
+    KIND_RESTART,
+    HistorySpec,
+    Workload,
+    retry_token_op,
+    user_kind,
+)
 
 _H_INIT = 0
 _H_WRITE = 1  # at primary: args = (seq,)
@@ -352,11 +359,14 @@ def make_kvchaos(
     def on_areq(ctx):
         # army op arrival at the client (a ClientArmy pool row): mark
         # the invoke and open the session — args[1] carries the number
-        # of probe rounds still owed after this one. No retries — an
-        # open-loop client does not slow down (or re-offer) because
-        # the system is struggling; a lost probe is an op that never
-        # completes, which is exactly the tail signal.
-        op_id = ctx.args[0]
+        # of probe rounds still owed after this one. The client itself
+        # never re-offers — an open-loop army does not slow down (or
+        # retry on its own) because the system is struggling; a modeled
+        # RetryPolicy re-delivers THIS handler with the attempt id in
+        # the token's high bits, so the op id is stripped (identity for
+        # plain attempt-0 tokens) and first-start-wins keeps the
+        # latency clock spanning first invoke -> final response.
+        op_id = retry_token_op(ctx.args[0])
         eb = ctx.emits()
         eb.lat_start(op_id)
         eb.send(
@@ -447,12 +457,15 @@ def client_army(
     t_max_ns: int = 400_000_000,
     n_replicas: int = 4,
     op_base: int = 0,
+    retry=None,
 ):
     """A :class:`chaos.ClientArmy` bound to kvchaos's client surface
     (``make_kvchaos(army=True)`` with the same ``n_replicas``): ops
     arrive at the client node and probe the primary. Compose it into a
     ``FaultPlan`` next to the chaos specs and run the sweep with
-    ``latency=LatencySpec(ops >= op_base + n_ops)``."""
+    ``latency=LatencySpec(ops >= op_base + n_ops)``. ``retry`` attaches
+    a :class:`chaos.RetryPolicy` (build the engine with
+    ``retry=plan.retry_spec()``)."""
     from ..chaos.plan import ClientArmy
 
     return ClientArmy(
@@ -462,6 +475,7 @@ def client_army(
         t_min_ns=t_min_ns,
         t_max_ns=t_max_ns,
         op_base=op_base,
+        retry=retry,
     )
 
 

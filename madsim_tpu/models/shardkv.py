@@ -55,6 +55,8 @@ from ..engine import (
     HistorySpec,
     StateContract,
     Workload,
+    retry_token_attempt,
+    retry_token_op,
     user_kind,
 )
 
@@ -62,6 +64,8 @@ from ..engine import (
 OP_SHARD_WRITE = OP_USER  # commit: key = shard, arg = version
 OP_SHARD_OWN = OP_USER + 1  # install: key = shard, arg = packed
 #                             (epoch, group, adopted version)
+OP_ARMY_PUT = OP_USER + 2  # army apply: key = op id, arg = attempt —
+#                            the stream check.exactly_once audits
 
 _H_INIT = 0
 _H_PUT_T = 1  # at client: write/progress timer
@@ -89,8 +93,10 @@ CLIENT = 1
 # shard versions — different nodes, the contracts below take the hull)
 _C_EPOCH, _C_PHASE, _C_MIG_S, _C_MIG_D = 0, 1, 2, 3
 _C_A0, _C_A1, _C_DONE, _C_FIN = 4, 5, 6, 7
-# client columns
-_K_EPOCH, _K_ACKED, _K_FIN = 0, 1, 2
+# client columns (col 3 = last army op APPLIED — the dedup floor the
+# exactly-once discipline lives in; shared with _C_MIG_D on the
+# controller, the contracts below take the hull)
+_K_EPOCH, _K_ACKED, _K_FIN, _K_APPLIED = 0, 1, 2, 3
 
 _P_KILL_AT = 0
 _P_KILL_WHO = 1
@@ -128,7 +134,7 @@ def make_shardkv(
     chaos: bool = True,
     record: bool = False,
     hist_capacity: int | None = None,
-    bug: bool = False,
+    bug: "bool | str" = False,
     army: bool = False,
     army_probes: int = 1,
 ) -> Workload:
@@ -136,14 +142,22 @@ def make_shardkv(
     key = shard, arg = version) at the serving primary and every shard
     install (OP_SHARD_OWN, key = shard, arg = the packed
     epoch/group/version word) at the installing primary — the two
-    streams check.shard_coverage audits.
+    streams check.shard_coverage audits. With ``army=True`` it also
+    records every army op APPLY (OP_ARMY_PUT, key = op id, arg =
+    attempt) at the client — the stream check.exactly_once audits.
 
     ``bug=True`` plants the lost-shard mutant (release-before-ack, see
-    module docstring). Requires ``record=True``.
+    module docstring). ``bug="noidem"`` plants the non-idempotent
+    retried-put mutant instead: the army apply skips its last-applied
+    guard and applies (and records) on EVERY delivery, so a modeled
+    retry whose first attempt did land applies the same op twice —
+    invisible to every final-state invariant (the guard column feeds
+    nothing else), caught only by check.exactly_once. Both require
+    ``record=True``; ``"noidem"`` additionally requires ``army=True``.
 
     ``army=True`` opens the client node as an open-loop surface
-    (``client_army``): ops probe the controller's config head,
-    read-only.
+    (``client_army``): ops probe the controller's config head and apply
+    an exactly-once put at the client.
     """
     G, R, S = n_groups, group_size, n_shards
     n = 2 + G * R
@@ -158,10 +172,20 @@ def make_shardkv(
     if width < 8:
         width = 8  # controller scalars need cols 0..7
         c_frozen = 2 * S
+    if bug not in (False, True, "noidem"):
+        raise ValueError(
+            f"bug must be False, True (lost-shard) or 'noidem' "
+            f"(non-idempotent retried put), got {bug!r}"
+        )
     if bug and not record:
         raise ValueError(
-            "bug=True plants a fault only histories can see; it requires "
+            "bug plants a fault only histories can see; it requires "
             "record=True (otherwise nothing would ever detect it)"
+        )
+    if bug == "noidem" and not army:
+        raise ValueError(
+            "bug='noidem' lives in the army apply path; it requires "
+            "army=True"
         )
     if army_probes < 1:
         raise ValueError(f"army_probes must be >= 1, got {army_probes}")
@@ -330,7 +354,7 @@ def make_shardkv(
         st = ctx.state
         owned = st[S + s] > 0
         eb = ctx.emits()
-        if bug:
+        if bug is True:
             # planted lost-shard mutant: the source treats "handoff
             # sent" as "migration done" — it releases the shard
             # immediately instead of waiting for the controller's
@@ -455,12 +479,41 @@ def make_shardkv(
         return new, eb.build()
 
     def on_areq(ctx):
-        op_id = ctx.args[0]
+        # army op arrival at the client: an exactly-once PUT. The token
+        # may carry a retry attempt id in its high bits (chaos
+        # RetryPolicy re-deliveries), so the op id is stripped first
+        # (identity for plain attempt-0 tokens). The clean client
+        # dedups on a floor (col _K_APPLIED = last applied op id + 1,
+        # so op 0 passes the zero-initialised floor): ops are offered
+        # in increasing id order, so ``op >= floor`` admits each op
+        # once and swallows both retried and reordered older
+        # deliveries — structurally zero exactly-once violations. The
+        # floor column feeds nothing else (no send, no coverage, no
+        # invariant), which is exactly why a double-apply is invisible
+        # to final-state checking and needs the history detector.
+        op_id = retry_token_op(ctx.args[0])
+        att = retry_token_attempt(ctx.args[0])
+        st = ctx.state
+        if bug == "noidem":
+            # planted non-idempotent mutant: "the handler is the apply"
+            # — every delivery applies and records, so a retry whose
+            # first attempt DID land (response slow, not lost) applies
+            # the same op twice. Only check.exactly_once sees it.
+            applied = jnp.bool_(True)
+        else:
+            applied = op_id >= st[_K_APPLIED]
+        new = jnp.where(
+            applied,
+            st.at[_K_APPLIED].set(jnp.clip(op_id + 1, 0, VER_CAP)),
+            st,
+        )
         eb = ctx.emits()
+        if record:
+            eb.record(OP_ARMY_PUT, op_id, att, ok=OK_OK, when=applied)
         eb.lat_start(op_id)
         eb.send(CONTROLLER, user_kind(_H_APROBE),
                 (op_id, jnp.int32(army_probes - 1)))
-        return ctx.state, eb.build()
+        return new, eb.build()
 
     def on_aprobe(ctx):
         eb = ctx.emits()
@@ -524,15 +577,21 @@ def make_shardkv(
 
     hist = None
     if record:
+        # the army term covers the default client_army (256 ops) at 4
+        # deliveries each — retried armies larger than that should pass
+        # hist_capacity explicitly
         cap = (
-            2 * writes + 4 * n_migs + 16
+            2 * writes + 4 * n_migs + 16 + (1024 if army else 0)
             if hist_capacity is None else hist_capacity
         )
         hist = HistorySpec(capacity=cap, max_records=1)
 
     name = "shardkv"
     if record:
-        name += "-bug" if bug else "-record"
+        if bug == "noidem":
+            name += "-noidem"
+        else:
+            name += "-bug" if bug else "-record"
     if army:
         name += "-army"
     handler_names = (
@@ -583,10 +642,13 @@ def client_army(
     t_min_ns: int = 20_000_000,
     t_max_ns: int = 400_000_000,
     op_base: int = 0,
+    retry=None,
 ):
     """A :class:`chaos.ClientArmy` bound to shardkv's client surface
-    (``make_shardkv(army=True)``): ops arrive at the client node and
-    probe the controller's config head — read-only."""
+    (``make_shardkv(army=True)``): ops arrive at the client node, apply
+    an exactly-once put, and probe the controller's config head.
+    ``retry`` attaches a :class:`chaos.RetryPolicy` (build the engine
+    with ``retry=plan.retry_spec()``)."""
     from ..chaos.plan import ClientArmy
 
     return ClientArmy(
@@ -596,6 +658,7 @@ def client_army(
         t_min_ns=t_min_ns,
         t_max_ns=t_max_ns,
         op_base=op_base,
+        retry=retry,
     )
 
 

@@ -17,7 +17,14 @@ of ``engine.replay.ReplayEvent``) into the Trace Event JSON format that
   (``emit_ns < 0`` too) fall back to the historical approximation:
   the sender's last dispatch at-or-before the delivery — which two
   same-timestamp sends can mis-attribute (the tested reason the
-  causal path exists);
+  causal path exists). Client-army deliveries under a retry policy
+  (``chaos.RetryPolicy``) name the arrow by **(op, attempt)** decoded
+  from the packed op token, so a re-send of op 7 reads
+  ``msg n1->n0 op7 try2`` — the same ambiguity class as the Duplicate
+  mis-anchors banked in CAUSAL_r13.txt, disambiguated in the label
+  whenever the send-time anchor (sidecar or causal) is present.
+  Attempt-0 tokens are plain op ids, so off-policy traces are
+  byte-identical to pre-retry exports;
 * **chaos spans** — kill/restart, pause/resume, clog/unclog (node,
   link, and one-way forms), slow/unslow, dup on/off, and disk-fault
   (lying-fsync / torn-write) window pairs from the dispatched stream
@@ -56,6 +63,8 @@ from ..engine.core import (
     KIND_UNCLOG_NODE,
     KIND_UNSLOW,
     Workload,
+    retry_token_attempt,
+    retry_token_op,
     unpack_slow_arg,
 )
 
@@ -105,6 +114,25 @@ _SPAN_CLOSERS = {v[0]: k for k, v in _SPAN_PAIRS.items()}
 def _us(t_ns: int) -> float:
     """Trace-event timestamps are microseconds (fractions allowed)."""
     return t_ns / 1e3
+
+
+def _flow_name(e) -> str:
+    """Arrow label for a delivery — attempt-aware for retried ops.
+
+    User-kind deliveries carry a packed op token in ``args[0]``
+    (engine.retry_token); a nonzero attempt id marks a RetryPolicy
+    re-send, which is the same arrow-anchoring ambiguity as a
+    Duplicate re-delivery (CAUSAL_r13.txt) — so the label names the
+    (op, attempt) pair and the anchor (sidecar emit time or causal
+    parent) disambiguates which send the arrow leaves. Attempt-0
+    tokens are plain op ids: off-policy labels are unchanged.
+    """
+    base = f"msg n{e.src}->n{e.node}"
+    if FIRST_USER_KIND <= e.kind < FIRST_EXT_KIND and len(e.args) > 0:
+        att = retry_token_attempt(int(e.args[0]))
+        if att > 0:
+            return f"{base} op{retry_token_op(int(e.args[0]))} try{att}"
+    return base
 
 
 def to_perfetto(
@@ -202,7 +230,7 @@ def to_perfetto(
             p = events[parent_i]
             out.append({
                 "ph": "s", "cat": "flow", "id": flow_id,
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": p.node, "tid": 0,
                 # the emitting dispatch's own timestamp IS the send
                 # time (emission happens during its handler), so the
@@ -212,19 +240,19 @@ def to_perfetto(
             })
             out.append({
                 "ph": "f", "cat": "flow", "id": flow_id, "bp": "e",
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": pid, "tid": 0, "ts": _us(e.time_ns),
             })
             flow_id += 1
         elif e.src >= 0 and emit_ns >= 0:
             out.append({
                 "ph": "s", "cat": "flow", "id": flow_id,
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": e.src, "tid": 0, "ts": _us(emit_ns),
             })
             out.append({
                 "ph": "f", "cat": "flow", "id": flow_id, "bp": "e",
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": pid, "tid": 0, "ts": _us(e.time_ns),
             })
             flow_id += 1
@@ -232,12 +260,12 @@ def to_perfetto(
             s = events[last_idx_at_node[e.src]]
             out.append({
                 "ph": "s", "cat": "flow", "id": flow_id,
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": s.node, "tid": 0, "ts": _us(s.time_ns),
             })
             out.append({
                 "ph": "f", "cat": "flow", "id": flow_id, "bp": "e",
-                "name": f"msg n{e.src}->n{e.node}",
+                "name": _flow_name(e),
                 "pid": pid, "tid": 0, "ts": _us(e.time_ns),
             })
             flow_id += 1
